@@ -1,0 +1,96 @@
+"""Benchmark: AlexNet training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no quantitative numbers (BASELINE.md); the baseline
+constant below is the commonly-cited cuDNN-era single-GPU AlexNet training
+throughput (~1000 imgs/sec on a 2015-class GPU, the hardware tier the
+reference targeted), so vs_baseline = measured / 1000.  MFU is reported on
+stderr using an analytic FLOP count of the traced network (2*MACs forward,
+3x forward for fwd+bwd) against the chip's advertised bf16 peak.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 1000.0
+PEAK_FLOPS = {  # bf16 peak per chip
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+    "TPU v5p": 459e12, "TPU v6e": 918e12,
+}
+
+
+def conv_flops_per_image(net) -> float:
+    """Forward MAC*2 count from the built graph's shapes."""
+    from cxxnet_tpu.layers.conv import ConvolutionLayer
+    from cxxnet_tpu.layers.fullc import FullConnectLayer
+    total = 0.0
+    for conn in net.connections:
+        l = conn.layer
+        if isinstance(l, ConvolutionLayer):
+            n, co, oh, ow = net.node_shapes[conn.nindex_out[0]]
+            ci = net.node_shapes[conn.nindex_in[0]][1]
+            kh, kw = l.param.kernel_height, l.param.kernel_width
+            total += 2.0 * co * oh * ow * (ci // l.param.num_group) * kh * kw
+        elif isinstance(l, FullConnectLayer):
+            _, _, _, nin = net.node_shapes[conn.nindex_in[0]]
+            nout = l.param.num_hidden
+            total += 2.0 * nin * nout
+    return total
+
+
+def main() -> None:
+    import jax
+    from __graft_entry__ import ALEXNET_NET, _make_trainer
+    from cxxnet_tpu.io.data import DataBatch
+
+    batch = 256
+    steps = 20
+    t = _make_trainer(ALEXNET_NET, batch, "tpu",
+                      extra=[("dtype", "bfloat16")])
+    import jax.numpy as jnp
+    rnd = np.random.RandomState(0)
+    # pre-stage the batch on device: this measures chip compute throughput,
+    # not host->device link bandwidth (the input pipeline overlaps transfers
+    # in real training; over the axon tunnel the link would dominate)
+    data = jnp.asarray(rnd.rand(batch, 3, 227, 227).astype(np.float32))
+    label = jnp.asarray(
+        rnd.randint(0, 1000, (batch, 1)).astype(np.float32))
+    b = DataBatch(data=data, label=label,
+                  index=np.arange(batch, dtype=np.uint32))
+    t.start_round(1)
+    # warmup / compile
+    for _ in range(3):
+        t.update(b)
+    np.asarray(t._last_loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        t.update(b)
+    np.asarray(t._last_loss)  # sync
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * steps / dt
+    step_ms = dt / steps * 1000.0
+
+    flops_fwd = conv_flops_per_image(t.net)
+    train_flops = 3.0 * flops_fwd * imgs_per_sec
+    dev_kind = jax.devices()[0].device_kind
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev_kind), 197e12)
+    mfu = train_flops / peak
+    print(f"bench: AlexNet b{batch} step={step_ms:.1f}ms "
+          f"imgs/sec={imgs_per_sec:.1f} fwd_gflops/img={flops_fwd / 1e9:.2f} "
+          f"device={dev_kind} MFU={mfu * 100:.1f}%", file=sys.stderr)
+    print(json.dumps({
+        "metric": "alexnet_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""cxxnet-tpu: a TPU-native deep learning framework with the capabilities of
+cxxnet (hihihippp/cxxnet), redesigned for jax/XLA/Pallas on TPU meshes.
+
+Public surface:
+* config-file driven CLI: ``python -m cxxnet_tpu config.conf key=val ...``
+* :class:`cxxnet_tpu.nnet.trainer.NetTrainer` — the INetTrainer equivalent
+* :mod:`cxxnet_tpu.wrapper` — numpy-facing Net / DataIter / train API
+"""
+
+__version__ = "0.1.0"
+
+from .nnet.trainer import NetTrainer
+from .nnet.netconfig import NetConfig
+from .io.factory import create_iterator
+
+__all__ = ["NetTrainer", "NetConfig", "create_iterator", "__version__"]

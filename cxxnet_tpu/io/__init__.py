@@ -1,0 +1,5 @@
+from .data import DataBatch, DataInst, IIterator
+from .factory import create_iterator, init_iterator
+
+__all__ = ["DataBatch", "DataInst", "IIterator", "create_iterator",
+           "init_iterator"]

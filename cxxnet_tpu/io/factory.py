@@ -1,0 +1,65 @@
+"""Iterator chain factory (reference ``src/io/data.cpp:23-74``).
+
+``iter = mnist|img|imgbin`` create base iterators (img/imgbin are wrapped
+``BatchAdapt(Augment(base))`` exactly like the reference); ``iter =
+threadbuffer|membuffer|attachtxt`` stack on top.  All config keys seen so
+far in the section are forwarded to every stage (reference: SetParam on the
+whole chain).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .data import IIterator
+from .imbin import ImageBinIterator, ImageIterator
+from .iter_mnist import MNISTIterator
+from .iter_proc import (AttachTxtIterator, AugmentIterator,
+                        BatchAdaptIterator, DenseBufferIterator,
+                        ThreadBufferIterator)
+
+
+def create_iterator(cfg: List[Tuple[str, str]]) -> IIterator:
+    it: IIterator = None
+    pending: List[Tuple[str, str]] = []
+    for name, val in cfg:
+        if name == "iter":
+            if val == "mnist":
+                assert it is None, "mnist cannot chain over another iterator"
+                it = MNISTIterator()
+            elif val == "imgbin" or val == "imgbinx":
+                assert it is None, "imgbin cannot chain over another iterator"
+                it = BatchAdaptIterator(AugmentIterator(ImageBinIterator()))
+            elif val == "img":
+                assert it is None, "img cannot chain over another iterator"
+                it = BatchAdaptIterator(AugmentIterator(ImageIterator()))
+            elif val == "threadbuffer":
+                assert it is not None, "must specify input of threadbuffer"
+                it = ThreadBufferIterator(it)
+            elif val == "membuffer":
+                assert it is not None, "must specify input of membuffer"
+                it = DenseBufferIterator(it)
+            elif val == "attachtxt":
+                assert it is not None, "must specify input of attachtxt"
+                it = AttachTxtIterator(it)
+            elif val == "end":
+                continue
+            else:
+                raise ValueError(f"unknown iterator type {val!r}")
+            for n, v in pending:
+                it.set_param(n, v)
+            continue
+        if it is not None:
+            it.set_param(name, val)
+        else:
+            pending.append((name, val))
+    assert it is not None, "must specify iterator by iter=itername"
+    return it
+
+
+def init_iterator(it: IIterator, defcfg: List[Tuple[str, str]]) -> IIterator:
+    """Apply global config then Init (reference InitIter)."""
+    for n, v in defcfg:
+        it.set_param(n, v)
+    it.init()
+    return it

@@ -1,0 +1,113 @@
+"""MNIST idx-ubyte iterator.
+
+Reference: ``src/io/iter_mnist-inl.hpp`` — reads the gzip idx files, scales
+pixels by 1/256, optional in-memory shuffle, emits fixed-size batches
+(tail instances beyond the last full batch are dropped, like the reference's
+``loc_ + batch_size <= ndata`` loop; set ``round_batch = 1`` to instead wrap
+the final batch and report ``num_batch_padd``, which TPU static shapes
+prefer for eval).
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from .data import DataBatch, IIterator
+
+_RAND_MAGIC = 27  # distinct fixed seed per subsystem, reference style
+
+
+class MNISTIterator(IIterator):
+    def __init__(self):
+        self.silent = 0
+        self.batch_size = 0
+        self.input_flat = 1
+        self.shuffle = 0
+        self.index_offset = 0
+        self.path_img = ""
+        self.path_label = ""
+        self.round_batch = 0
+        self.seed_data = 0
+        self.loc = 0
+
+    def set_param(self, name, val):
+        if name == "silent":
+            self.silent = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "input_flat":
+            self.input_flat = int(val)
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "index_offset":
+            self.index_offset = int(val)
+        elif name == "path_img":
+            self.path_img = val
+        elif name == "path_label":
+            self.path_label = val
+        elif name == "round_batch":
+            self.round_batch = int(val)
+        elif name == "seed_data":
+            self.seed_data = int(val)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def init(self):
+        with self._open(self.path_img) as f:
+            magic, n, rows, cols = struct.unpack(">iiii", f.read(16))
+            self.img = np.frombuffer(f.read(n * rows * cols), np.uint8) \
+                .reshape(n, rows, cols).astype(np.float32) * (1.0 / 256.0)
+        with self._open(self.path_label) as f:
+            magic, n_lab = struct.unpack(">ii", f.read(8))
+            self.labels = np.frombuffer(f.read(n_lab), np.uint8) \
+                .astype(np.float32)
+        self.inst = np.arange(len(self.labels), dtype=np.uint32) \
+            + self.index_offset
+        if self.shuffle:
+            rnd = np.random.RandomState(_RAND_MAGIC + self.seed_data)
+            order = rnd.permutation(len(self.labels))
+            self.img = self.img[order]
+            self.labels = self.labels[order]
+            self.inst = self.inst[order]
+        assert self.batch_size > 0, "mnist: batch_size must be set"
+        if not self.silent:
+            shape = (self.batch_size, 1, 1, self.img.shape[1] * self.img.shape[2]) \
+                if self.input_flat else \
+                (self.batch_size, 1, self.img.shape[1], self.img.shape[2])
+            print(f"MNISTIterator: load {len(self.img)} images, "
+                  f"shuffle={self.shuffle}, shape={shape}")
+
+    def before_first(self):
+        self.loc = 0
+
+    def _view(self, idx: np.ndarray) -> np.ndarray:
+        d = self.img[idx]
+        n = len(idx)
+        if self.input_flat:
+            return d.reshape(n, 1, 1, -1)
+        return d.reshape(n, 1, d.shape[1], d.shape[2])
+
+    def next(self):
+        n = len(self.labels)
+        bs = self.batch_size
+        if self.loc + bs <= n:
+            idx = np.arange(self.loc, self.loc + bs)
+            self.loc += bs
+            return DataBatch(data=self._view(idx),
+                             label=self.labels[idx].reshape(bs, 1),
+                             index=self.inst[idx])
+        if self.round_batch and self.loc < n:
+            remain = n - self.loc
+            idx = np.concatenate([np.arange(self.loc, n),
+                                  np.arange(0, bs - remain)])
+            self.loc = n
+            return DataBatch(data=self._view(idx),
+                             label=self.labels[idx].reshape(bs, 1),
+                             index=self.inst[idx],
+                             num_batch_padd=bs - remain)
+        return None

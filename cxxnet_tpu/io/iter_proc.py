@@ -1,0 +1,374 @@
+"""Batch adaptation, augmentation, prefetch, and buffering stages.
+
+Reference: ``src/io/iter_batch_proc-inl.hpp`` (BatchAdaptIterator +
+ThreadBufferIterator), ``iter_augment_proc-inl.hpp`` (crop/mirror/mean-sub
+pipeline), ``iter_mem_buffer-inl.hpp`` (DenseBufferIterator),
+``iter_attach_txt-inl.hpp`` (side-feature join).  The double-buffered
+producer thread mirrors utils/thread_buffer.h with a bounded queue.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from .data import DataBatch, DataInst, IIterator
+
+_AUG_RAND_MAGIC = 111
+
+
+class BatchAdaptIterator(IIterator):
+    """Packs DataInst into DataBatch (iter_batch_proc-inl.hpp:16-133).
+
+    ``round_batch = 1`` wraps the epoch boundary and records
+    ``num_batch_padd``; otherwise the tail partial batch is dropped.
+    ``test_skipread = 1`` returns the same batch without reading (I/O
+    isolation benchmark mode, :72-74).
+    """
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.batch_size = 0
+        self.round_batch = 0
+        self.test_skipread = 0
+        self.label_width = 1
+        self._head = True
+        self._cached: Optional[DataBatch] = None
+        self._wrap_insts: List[DataInst] = []
+
+    def set_param(self, name, val):
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "round_batch":
+            self.round_batch = int(val)
+        elif name == "test_skipread":
+            self.test_skipread = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        self.base.set_param(name, val)
+
+    def init(self):
+        assert self.batch_size > 0, "batch_size must be set"
+        self.base.init()
+
+    def before_first(self):
+        self._epoch_done = False
+        if self.test_skipread and self._cached is not None:
+            return
+        self.base.before_first()
+
+    def _collect(self, n: int) -> List[DataInst]:
+        out = []
+        while len(out) < n:
+            inst = self.base.next()
+            if inst is None:
+                break
+            out.append(inst)
+        return out
+
+    def _pack(self, insts: List[DataInst], padd: int) -> DataBatch:
+        data = np.stack([i.data for i in insts]).astype(np.float32)
+        label = np.stack([np.atleast_1d(i.label)[:self.label_width]
+                          for i in insts]).astype(np.float32)
+        index = np.array([i.index for i in insts], np.uint32)
+        return DataBatch(data=data, label=label, index=index,
+                         num_batch_padd=padd)
+
+    def next(self):
+        if self.test_skipread and self._cached is not None:
+            return self._cached
+        if getattr(self, "_epoch_done", False):
+            return None
+        insts = self._collect(self.batch_size)
+        if len(insts) == self.batch_size:
+            b = self._pack(insts, 0)
+        elif not insts:
+            return None
+        elif self.round_batch:
+            # wrap around to the beginning of the epoch; the wrapped batch is
+            # the epoch's last (the rewound base must not keep feeding)
+            need = self.batch_size - len(insts)
+            self.base.before_first()
+            wrap = self._collect(need)
+            assert len(wrap) == need, "round_batch: dataset smaller than batch"
+            b = self._pack(insts + wrap, need)
+            self._epoch_done = True
+        else:
+            return None
+        if self.test_skipread:
+            self._cached = b
+        return b
+
+
+class AugmentIterator(IIterator):
+    """Per-instance augmentation (iter_augment_proc-inl.hpp:21-246):
+    random/fixed crop, mirror, mean subtraction (mean image file generated on
+    first use, :171-198, or mean_value RGB), scale."""
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.rand_crop = 0
+        self.rand_mirror = 0
+        self.mirror = 0
+        self.input_shape = None  # (c, y, x)
+        self.mean_file = ""
+        self.mean_value: Optional[np.ndarray] = None
+        self.scale = 1.0
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.rnd = np.random.RandomState(_AUG_RAND_MAGIC)
+        self._mean: Optional[np.ndarray] = None
+
+    def set_param(self, name, val):
+        if name == "rand_crop":
+            self.rand_crop = int(val)
+        elif name == "rand_mirror":
+            self.rand_mirror = int(val)
+        elif name == "mirror":
+            self.mirror = int(val)
+        elif name == "input_shape":
+            self.input_shape = tuple(int(t) for t in val.split(","))
+        elif name == "image_mean":
+            self.mean_file = val
+        elif name == "mean_value":
+            self.mean_value = np.array(
+                [float(t) for t in val.split(",")], np.float32)
+        elif name == "scale":
+            self.scale = float(val)
+        elif name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        elif name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        elif name == "crop_y_start":
+            self.crop_y_start = int(val)
+        elif name == "crop_x_start":
+            self.crop_x_start = int(val)
+        self.base.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+        if self.mean_file:
+            if os.path.exists(self.mean_file):
+                self._mean = np.load(self.mean_file)["mean"]
+            else:
+                self._create_mean_img()
+
+    def _create_mean_img(self):
+        """Average all instances into a mean image (CreateMeanImg parity)."""
+        self.base.before_first()
+        acc = None
+        n = 0
+        while True:
+            inst = self.base.next()
+            if inst is None:
+                break
+            if acc is None:
+                acc = inst.data.astype(np.float64)
+            else:
+                acc += inst.data
+            n += 1
+        assert n > 0, "augment: empty dataset, cannot build mean image"
+        self._mean = (acc / n).astype(np.float32)
+        np.savez(self.mean_file, mean=self._mean)
+        print(f"AugmentIterator: saved mean image to {self.mean_file}")
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self):
+        inst = self.base.next()
+        if inst is None:
+            return None
+        d = inst.data.astype(np.float32)
+        if self._mean is not None and self._mean.shape == d.shape:
+            d = d - self._mean
+        elif self.mean_value is not None:
+            d = d - self.mean_value.reshape(-1, 1, 1)
+        if self.max_random_contrast > 0:
+            c = 1.0 + (self.rnd.rand() * 2 - 1) * self.max_random_contrast
+            d = d * c
+        if self.max_random_illumination > 0:
+            d = d + (self.rnd.rand() * 2 - 1) * self.max_random_illumination
+        if self.input_shape is not None and self.input_shape[1:] != d.shape[1:]:
+            cy, cx = self.input_shape[1], self.input_shape[2]
+            assert d.shape[1] >= cy and d.shape[2] >= cx, \
+                f"augment: crop {cy}x{cx} larger than input {d.shape}"
+            if self.rand_crop:
+                y0 = self.rnd.randint(0, d.shape[1] - cy + 1)
+                x0 = self.rnd.randint(0, d.shape[2] - cx + 1)
+            else:
+                y0 = self.crop_y_start if self.crop_y_start >= 0 \
+                    else (d.shape[1] - cy) // 2
+                x0 = self.crop_x_start if self.crop_x_start >= 0 \
+                    else (d.shape[2] - cx) // 2
+            d = d[:, y0:y0 + cy, x0:x0 + cx]
+        if self.mirror or (self.rand_mirror and self.rnd.rand() < 0.5):
+            d = d[:, :, ::-1].copy()
+        if self.scale != 1.0:
+            d = d * self.scale
+        return DataInst(label=inst.label, data=d, index=inst.index)
+
+
+class ThreadBufferIterator(IIterator):
+    """Batch-level prefetch on a producer thread
+    (iter_batch_proc-inl.hpp:136-224 over utils/thread_buffer.h).
+
+    Each epoch gets its own queue + producer thread; a generation counter
+    poisons stale producers, and before_first() joins the previous producer
+    before rewinding the (shared) base iterator, so exactly one thread ever
+    touches the base.
+    """
+
+    def __init__(self, base: IIterator, max_buffer: int = 4):
+        self.base = base
+        self.max_buffer = max_buffer
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._gen = 0
+
+    def set_param(self, name, val):
+        if name == "buffer_size":
+            self.max_buffer = max(1, int(val))
+        self.base.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+
+    def _producer(self, gen: int, q: "queue.Queue"):
+        while True:
+            b = self.base.next()
+            # bounded put that re-checks the generation so a stale producer
+            # exits instead of blocking forever on an orphaned queue
+            while True:
+                if self._gen != gen:
+                    return
+                try:
+                    q.put(b, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+            if b is None:
+                return
+
+    def before_first(self):
+        self._gen += 1
+        if self._thread is not None:
+            self._thread.join()  # unblocks via the generation check
+        self.base.before_first()
+        q = queue.Queue(maxsize=self.max_buffer)
+        self._queue = q
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._gen, q), daemon=True)
+        self._thread.start()
+
+    def next(self):
+        assert self._queue is not None, "call before_first() first"
+        return self._queue.get()
+
+
+class DenseBufferIterator(IIterator):
+    """Caches the first max_nbatch batches in RAM and loops over them
+    (iter_mem_buffer-inl.hpp:16-76)."""
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.max_nbatch = 0
+        self._cache: List[DataBatch] = []
+        self._filled = False
+        self._pos = 0
+
+    def set_param(self, name, val):
+        if name == "max_nbatch":
+            self.max_nbatch = int(val)
+        self.base.set_param(name, val)
+
+    def init(self):
+        assert self.max_nbatch > 0, "membuffer: set max_nbatch"
+        self.base.init()
+
+    def before_first(self):
+        self._pos = 0
+        if not self._filled:
+            self.base.before_first()
+
+    def next(self):
+        if self._filled:
+            if self._pos >= len(self._cache):
+                return None
+            b = self._cache[self._pos]
+            self._pos += 1
+            return b
+        if len(self._cache) >= self.max_nbatch:
+            self._filled = True
+            return None
+        b = self.base.next()
+        if b is None:
+            self._filled = True
+            return None
+        self._cache.append(b)
+        self._pos = len(self._cache)
+        return b
+
+
+class AttachTxtIterator(IIterator):
+    """Joins per-instance side features from a text file into
+    ``batch.extra_data``, keyed by instance index
+    (iter_attach_txt-inl.hpp:15-99).  File format: each line is
+    ``inst_index v1 v2 ... vk``; shape from ``extra_shape[i] = c,y,x``."""
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.path_txt = ""
+        self.extra_shapes: List[tuple] = []
+        self._table = {}
+
+    def set_param(self, name, val):
+        import re
+        if name == "path_attach_txt" or name == "path_txt":
+            self.path_txt = val
+        m = re.match(r"^extra_data_shape\[(\d+)\]$", name)
+        if m:
+            idx = int(m.group(1))
+            shape = tuple(int(t) for t in val.split(","))
+            while len(self.extra_shapes) <= idx:
+                self.extra_shapes.append(None)
+            self.extra_shapes[idx] = shape
+        self.base.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+        assert self.path_txt, "attachtxt: set path_attach_txt"
+        with open(self.path_txt) as f:
+            for line in f:
+                toks = line.split()
+                if not toks:
+                    continue
+                self._table[int(toks[0])] = np.array(
+                    [float(t) for t in toks[1:]], np.float32)
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self):
+        b = self.base.next()
+        if b is None:
+            return None
+        feats = np.stack([self._table[int(i)] for i in b.index])
+        extra = []
+        if self.extra_shapes and self.extra_shapes[0] is not None:
+            off = 0
+            for shape in self.extra_shapes:
+                size = int(np.prod(shape))
+                extra.append(feats[:, off:off + size]
+                             .reshape((len(feats),) + shape))
+                off += size
+        else:
+            extra.append(feats.reshape(len(feats), 1, 1, -1))
+        b.extra_data = extra
+        return b

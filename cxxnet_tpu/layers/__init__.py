@@ -1,0 +1,7 @@
+from .base import (ForwardContext, LabelInfo, Layer, LayerParam, Shape4,
+                   as_mat, mat_shape)
+from .registry import create_layer, layer_type_names, register
+
+__all__ = ["ForwardContext", "LabelInfo", "Layer", "LayerParam", "Shape4",
+           "as_mat", "mat_shape", "create_layer", "layer_type_names",
+           "register"]

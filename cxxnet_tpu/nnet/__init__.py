@@ -1,0 +1,5 @@
+from .net import Connection, Network
+from .netconfig import LayerInfo, NetConfig
+from .trainer import NetTrainer
+
+__all__ = ["Connection", "Network", "LayerInfo", "NetConfig", "NetTrainer"]

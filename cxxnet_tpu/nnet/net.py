@@ -1,0 +1,184 @@
+"""Network graph: layer instantiation, shape inference, functional forward.
+
+Reference: ``NeuralNet<xpu>`` (``src/nnet/neural_net-inl.hpp:23-297``).  The
+reference owns mutable node buffers and runs Forward/Backprop layer by layer
+on a device stream; here the whole graph is a pure function over an SSA node
+environment, traced once and compiled by XLA — backprop is jax.grad of the
+summed loss terms, so there are no hand-written Backprop methods and no
+per-layer stream syncs (the reference needed one per layer with updaters,
+neural_net-inl.hpp:148).
+
+Layer sharing (``share[tag]``) reuses the primary connection's layer instance
+and parameter group, reproducing kSharedLayer (neural_net-inl.hpp:238-244).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers.base import ForwardContext, LabelInfo, Layer, Shape4
+from ..layers.registry import create_layer
+from ..layers.shape_ops import SplitLayer
+from .netconfig import NetConfig
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+
+
+@dataclasses.dataclass
+class Connection:
+    """Binds a layer instance to node ids (reference layer.h:380-407)."""
+
+    layer: Layer
+    nindex_in: List[int]
+    nindex_out: List[int]
+    # parameter-group key; shared connections point at the primary's key
+    param_key: str
+    owns_params: bool
+
+
+class Network:
+    """Static graph built from a NetConfig; all state lives in pytrees."""
+
+    def __init__(self, cfg: NetConfig, batch_size: int, dtype=jnp.float32):
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.dtype = dtype
+        self.connections: List[Connection] = []
+        self.node_shapes: List[Optional[Shape4]] = [None] * cfg.num_nodes
+        self._build()
+        self._infer_shapes()
+
+    # -- construction -----------------------------------------------------
+    def _layer_key(self, index: int, info) -> str:
+        base = info.name if info.name else info.type_name
+        return f"{index:02d}-{base}"
+
+    def _build(self) -> None:
+        cfg = self.cfg
+        for i, info in enumerate(cfg.layers):
+            if info.is_shared:
+                primary = self.connections[info.primary_layer_index]
+                conn = Connection(layer=primary.layer,
+                                  nindex_in=list(info.nindex_in),
+                                  nindex_out=list(info.nindex_out),
+                                  param_key=primary.param_key,
+                                  owns_params=False)
+                self.connections.append(conn)
+                continue
+            layer = create_layer(info.type_name)
+            layer.name = info.name
+            if isinstance(layer, SplitLayer):
+                layer.num_out = len(info.nindex_out)
+            # global keys are re-broadcast to every layer, then the layer's own
+            # section (reference neural_net-inl.hpp:252-264)
+            for k, v in cfg.defcfg:
+                layer.set_param(k, v)
+            for k, v in cfg.layercfg[i]:
+                layer.set_param(k, v)
+            self.connections.append(Connection(
+                layer=layer, nindex_in=list(info.nindex_in),
+                nindex_out=list(info.nindex_out),
+                param_key=self._layer_key(i, info), owns_params=True))
+
+    def _infer_shapes(self) -> None:
+        cfg = self.cfg
+        assert cfg.input_shape is not None, "input_shape must be configured"
+        c, y, x = cfg.input_shape
+        self.node_shapes[0] = (self.batch_size, c, y, x)
+        for i in range(cfg.extra_data_num):
+            ec, ey, ex = cfg.extra_shape[3 * i: 3 * i + 3]
+            self.node_shapes[1 + i] = (self.batch_size, ec, ey, ex)
+        for conn in self.connections:
+            in_shapes = []
+            for nid in conn.nindex_in:
+                assert self.node_shapes[nid] is not None, (
+                    f"node {cfg.node_names[nid]!r} used before being produced")
+                in_shapes.append(self.node_shapes[nid])
+            out_shapes = conn.layer.infer_shapes(in_shapes)
+            assert len(out_shapes) == len(conn.nindex_out), (
+                f"layer {conn.layer.type_names[0]} produced {len(out_shapes)} "
+                f"outputs for {len(conn.nindex_out)} output nodes")
+            for nid, s in zip(conn.nindex_out, out_shapes):
+                self.node_shapes[nid] = s
+
+    # -- state ------------------------------------------------------------
+    def init_params(self, key: jax.Array) -> Params:
+        params: Params = {}
+        for i, conn in enumerate(self.connections):
+            if not conn.owns_params:
+                continue
+            sub = jax.random.fold_in(key, i)
+            in_shapes = [self.node_shapes[n] for n in conn.nindex_in]
+            p = conn.layer.init_params(sub, in_shapes, self.dtype)
+            if p:
+                params[conn.param_key] = p
+        return params
+
+    def init_buffers(self) -> Params:
+        buffers: Params = {}
+        for conn in self.connections:
+            if not conn.owns_params:
+                continue
+            in_shapes = [self.node_shapes[n] for n in conn.nindex_in]
+            b = conn.layer.init_buffers(in_shapes)
+            if b:
+                buffers[conn.param_key] = b
+        return buffers
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, params: Params, buffers: Params,
+                inputs: Dict[int, jnp.ndarray], ctx: ForwardContext
+                ) -> Tuple[List[Optional[jnp.ndarray]], Params]:
+        """Run all connections in declaration order.
+
+        Returns (node value list indexed by node id, updated buffers).
+        Node values are SSA: self-loop layers rebind their node's entry.
+        """
+        nodes: List[Optional[jnp.ndarray]] = [None] * self.cfg.num_nodes
+        for nid, v in inputs.items():
+            nodes[nid] = v.astype(self.dtype) if v.dtype != self.dtype else v
+        new_buffers = dict(buffers)
+        for conn in self.connections:
+            ins = [nodes[n] for n in conn.nindex_in]
+            p = params.get(conn.param_key, {})
+            b = new_buffers.get(conn.param_key, {})
+            outs, nb = conn.layer.forward(p, b, ins, ctx)
+            # shared connections update the primary's buffer group too: the
+            # next invocation reads the chained update (last write wins)
+            if nb:
+                new_buffers[conn.param_key] = nb
+            for n, v in zip(conn.nindex_out, outs):
+                nodes[n] = v
+        return nodes, new_buffers
+
+    # -- utilities ----------------------------------------------------------
+    def node_id(self, name: str) -> int:
+        """Resolve a node by name, or "top[-k]" pseudo-names
+        (reference nnet_impl-inl.hpp:204-215)."""
+        if name.startswith("top[") and name.endswith("]"):
+            k = int(name[4:-1])
+            # top[-1] = last node produced
+            last = self.connections[-1].nindex_out[-1]
+            return last + 1 + k if k < 0 else k
+        if name in self.cfg.node_name_map:
+            return self.cfg.node_name_map[name]
+        raise KeyError(f"unknown node name {name!r}")
+
+    @property
+    def final_node(self) -> int:
+        return self.connections[-1].nindex_out[-1]
+
+    def describe(self) -> str:
+        lines = []
+        for i, conn in enumerate(self.connections):
+            ins = ",".join(self.cfg.node_names[n] for n in conn.nindex_in)
+            outs = ",".join(self.cfg.node_names[n] for n in conn.nindex_out)
+            shapes = [self.node_shapes[n] for n in conn.nindex_out]
+            share = " (shared)" if not conn.owns_params else ""
+            lines.append(f"{i:3d} {conn.layer.type_names[0]:>20s}{share} "
+                         f"[{ins} -> {outs}] out={shapes}")
+        return "\n".join(lines)

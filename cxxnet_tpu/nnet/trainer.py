@@ -1,0 +1,512 @@
+"""NetTrainer: the public training API + the jitted SPMD step.
+
+Reference: ``INetTrainer`` (``src/nnet/nnet.h:18-92``) and its implementation
+``CXXNetThreadTrainer`` (``nnet_impl-inl.hpp:16-455``).  The reference runs
+one worker pthread per GPU, slices each batch across them, and aggregates
+gradients through mshadow-ps push/pull with per-layer priorities.  On TPU the
+entire Forward+Backprop+Update becomes ONE jitted function over a device
+mesh: the batch is sharded on the mesh's "data" axis, jax.grad's psum does
+the aggregation over ICI, and XLA's latency-hiding scheduler provides the
+comm/compute overlap the reference engineered by hand (priority =
+-layer_index, deferred big pulls — async_updater-inl.hpp:128-174).
+
+Capability mapping:
+* ``update_period`` grad accumulation     -> in-step accumulator + lax.cond
+* ``update_on_server`` optimizer offload  -> optimizer states can be sharded
+  over "data" (ZeRO-style) via ``shard_opt_state = 1``
+* ``fullc_gather`` activation-gather      -> fullc wmat sharded over "model"
+  axis (GSPMD inserts the all-gathers) via ``fullc_gather = 1`` + mesh config
+* ``test_on_server`` consistency check    -> :meth:`check_weight_consistency`
+* per-device seeds (i + seed*100)         -> one keyed threefry stream,
+  folded per step (deterministic regardless of mesh shape)
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..io.data import DataBatch
+from ..layers.base import ForwardContext, LabelInfo, as_mat
+from ..parallel import mesh as meshlib
+from ..updater import UpdaterHyper, create_updater
+from ..utils import serializer
+from ..utils.metric import MetricSet
+from .net import Network
+from .netconfig import NetConfig
+
+Pytree = Any
+
+
+class NetTrainer:
+    """Config-driven trainer (INetTrainer parity: SetParam/InitModel/
+    SaveModel/LoadModel/StartRound/Update/Evaluate/Predict/ExtractFeature/
+    CopyModelFrom/SetWeight/GetWeight)."""
+
+    def __init__(self) -> None:
+        self.cfg: List[Tuple[str, str]] = []
+        self.batch_size = 0
+        self.update_period = 1
+        self.sample_counter = 0
+        self.epoch_counter = 0
+        self.round = 0
+        self.seed = 0
+        self.dev = "tpu"
+        self.dtype = jnp.float32
+        self.mesh_spec: Optional[meshlib.MeshSpec] = None
+        self.fullc_gather = 0
+        self.shard_opt_state = 0
+        self.silent = 0
+        self.print_step = 100
+        # metric bindings: (metric_name, label_field, node_name or "")
+        self._metric_req: List[Tuple[str, str, str]] = []
+        self.metric = MetricSet()
+        self.train_metric = MetricSet()
+        self.net: Optional[Network] = None
+        self._train_step = None
+        self._eval_step_cache: Dict[Tuple[int, ...], Any] = {}
+
+    # ------------------------------------------------------------------ cfg
+    def set_param(self, name: str, val: str) -> None:
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "update_period":
+            self.update_period = int(val)
+        elif name == "seed":
+            self.seed = int(val)
+        elif name == "dev":
+            self.dev = val
+        elif name == "dtype":
+            self.dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                          "float16": jnp.float16}[val]
+        elif name == "mesh":
+            self.mesh_spec = meshlib.MeshSpec.parse(val)
+        elif name == "fullc_gather":
+            self.fullc_gather = int(val)
+        elif name == "shard_opt_state" or name == "update_on_server":
+            # update_on_server=1 (server-side optimizer states) maps to
+            # ZeRO-style optimizer-state sharding over the data axis
+            self.shard_opt_state = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "print_step":
+            self.print_step = int(val)
+        elif name.startswith("metric"):
+            # metric[label,node] = m | metric[label] = m | metric = m
+            import re
+            m = re.match(r"^metric\[([^,\]]+),([^\]]+)\]$", name)
+            if m:
+                self._metric_req.append((val, m.group(1), m.group(2)))
+            else:
+                m = re.match(r"^metric\[([^\]]+)\]$", name)
+                if m:
+                    self._metric_req.append((val, m.group(1), ""))
+                else:
+                    self._metric_req.append((val, "label", ""))
+        self.cfg.append((name, val))
+
+    # ----------------------------------------------------------------- init
+    def init_model(self) -> None:
+        netcfg = NetConfig()
+        netcfg.configure(self.cfg)
+        assert self.batch_size > 0, "batch_size must be set"
+        self.netcfg = netcfg
+        self.devices = meshlib.select_devices(self.dev)
+        if self.mesh_spec is None and len(self.devices) > 1:
+            self.mesh_spec = meshlib.MeshSpec({"data": len(self.devices)})
+        self.mesh = meshlib.build_mesh(
+            self.devices, self.mesh_spec) if (
+                self.mesh_spec or len(self.devices) > 1) else \
+            meshlib.build_mesh(self.devices)
+        self.net = Network(netcfg, self.batch_size, self.dtype)
+        key = jax.random.PRNGKey(self.seed * 100 + 11)
+        self.params = self.net.init_params(key)
+        self.buffers = self.net.init_buffers()
+        self._rng_base = jax.random.PRNGKey(self.seed)
+        self._post_build()
+        if not self.silent:
+            print(self.net.describe())
+
+    def _post_build(self) -> None:
+        """Everything derivable from (net, params): updaters, hypers,
+        shardings, step functions, metric bindings."""
+        net = self.net
+        self.updater = create_updater(self.netcfg.updater_type)
+        # hyper groups: per (param_key, tag); global cfg then the layer's own
+        # section (reference NeuralNet::InitUpdaters ordering)
+        self.hypers: Dict[str, Dict[str, UpdaterHyper]] = {}
+        key_to_layer_index = {}
+        for i, conn in enumerate(net.connections):
+            if conn.owns_params:
+                key_to_layer_index[conn.param_key] = i
+        for pkey, group in self.params.items():
+            self.hypers[pkey] = {}
+            li = key_to_layer_index.get(pkey)
+            for tag in _group_tags(group):
+                h = UpdaterHyper(tag=tag)
+                for k, v in self.netcfg.defcfg:
+                    h.set_param(k, v)
+                if li is not None:
+                    for k, v in self.netcfg.layercfg[li]:
+                        h.set_param(k, v)
+                self.hypers[pkey][tag] = h
+        self.opt_state = _map_group(
+            self.params, lambda tag, p: self.updater.init_state(p))
+        # eval-node requests (metric[label,node]); "" -> final node
+        self.eval_node_ids = []
+        for (_, _, node) in self._metric_req:
+            self.eval_node_ids.append(
+                net.node_id(node) if node else net.final_node)
+        self.metric = MetricSet()
+        self.train_metric = MetricSet()
+        for (mname, field, _) in self._metric_req:
+            self.metric.add_metric(mname, field)
+            self.train_metric.add_metric(mname, field)
+        self.loss_scale = 1.0 / (self.batch_size * self.update_period)
+        self._label_fields = self.netcfg.label_fields()
+        self._make_shardings()
+        self._train_step = self._build_train_step()
+        self._eval_step_cache = {}
+        self._grad_acc = None
+        self.sample_counter = 0
+        self.epoch_counter = 0
+
+    def _make_shardings(self) -> None:
+        mesh = self.mesh
+        self.batch_shard = meshlib.batch_sharding(mesh)
+        self.repl = meshlib.replicated(mesh)
+
+        def param_spec(pkey: str, tag: str, shape) -> NamedSharding:
+            if (self.fullc_gather and "model" in mesh.axis_names
+                    and tag == "wmat" and len(shape) == 2
+                    and shape[0] % mesh.shape["model"] == 0):
+                return NamedSharding(mesh, P("model", None))
+            return self.repl
+
+        self.param_shardings = {
+            pkey: {tag: param_spec(pkey, tag, p.shape)
+                   for tag, p in group.items()}
+            for pkey, group in self.params.items()}
+        self.opt_shardings = jax.tree.map(
+            lambda _: self.repl, self.opt_state)
+        if self.shard_opt_state and "data" in mesh.axis_names:
+            ndata = mesh.shape["data"]
+
+            def opt_spec(path_p):
+                p = path_p
+                if p.ndim >= 1 and p.shape[0] % ndata == 0 and p.size >= 2 ** 14:
+                    return NamedSharding(mesh, P("data"))
+                return self.repl
+            self.opt_shardings = jax.tree.map(opt_spec, self.opt_state)
+        self.buffer_shardings = jax.tree.map(lambda _: self.repl, self.buffers)
+        # place initial state
+        self.params = jax.device_put(self.params, self.param_shardings)
+        self.opt_state = jax.device_put(self.opt_state, self.opt_shardings)
+        self.buffers = jax.device_put(self.buffers, self.buffer_shardings)
+
+    # ----------------------------------------------------------- step build
+    def _forward(self, params, buffers, data, label_vec, extras, *, train,
+                 rng, epoch):
+        fields = {name: label_vec[:, a:b]
+                  for name, a, b in self._label_fields} if label_vec is not None else {}
+        ctx = ForwardContext(train=train, rng=rng,
+                             labels=LabelInfo(fields=fields) if fields else None,
+                             epoch=epoch, loss_scale=self.loss_scale)
+        inputs = {0: data}
+        for i, e in enumerate(extras):
+            inputs[1 + i] = e
+        nodes, new_buffers = self.net.forward(params, buffers, inputs, ctx)
+        return nodes, new_buffers, ctx
+
+    def _build_train_step(self):
+        accumulate = self.update_period > 1
+        updater = self.updater
+        hypers = self.hypers
+        eval_ids = tuple(dict.fromkeys(self.eval_node_ids))
+
+        def loss_and_grads(params, buffers, data, label_vec, extras, epoch, rng):
+            def loss_fn(p):
+                nodes, new_buffers, ctx = self._forward(
+                    p, buffers, data, label_vec, extras,
+                    train=True, rng=rng, epoch=epoch)
+                assert ctx.losses, "network has no loss layer; cannot train"
+                total = sum(ctx.losses[1:], ctx.losses[0])
+                outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                        for nid in eval_ids}
+                return total, (new_buffers, outs, ctx.diagnostics)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def apply_update(operand, epoch):
+            params, opt_state, grads = operand
+            new_p, new_s = {}, {}
+            for pkey, group in params.items():
+                new_p[pkey], new_s[pkey] = {}, {}
+                for tag, p in group.items():
+                    q, s = updater.apply(
+                        p, grads[pkey][tag], opt_state[pkey][tag],
+                        hypers[pkey][tag], epoch)
+                    new_p[pkey][tag] = q
+                    new_s[pkey][tag] = s
+            zeroed = jax.tree.map(jnp.zeros_like, grads)
+            return new_p, new_s, zeroed
+
+        if accumulate:
+            def step(params, opt_state, buffers, grad_acc, data, label_vec,
+                     extras, epoch, rng, do_update):
+                (loss, (new_buffers, outs, diags)), grads = loss_and_grads(
+                    params, buffers, data, label_vec, extras, epoch, rng)
+                grads = jax.tree.map(jnp.add, grad_acc, grads)
+                params, opt_state, grads = jax.lax.cond(
+                    do_update, lambda op: apply_update(op, epoch),
+                    lambda op: op, (params, opt_state, grads))
+                return (params, opt_state, new_buffers, grads,
+                        loss, outs, diags)
+
+            shardings_in = (self.param_shardings, self.opt_shardings,
+                            self.buffer_shardings, self.param_shardings,
+                            self.batch_shard, self.batch_shard,
+                            self.batch_shard, self.repl, self.repl, self.repl)
+            shardings_out = (self.param_shardings, self.opt_shardings,
+                             self.buffer_shardings, self.param_shardings,
+                             self.repl, self.repl, self.repl)
+            return jax.jit(step, in_shardings=shardings_in,
+                           out_shardings=shardings_out,
+                           donate_argnums=(0, 1, 2, 3))
+
+        def step(params, opt_state, buffers, data, label_vec,
+                 extras, epoch, rng):
+            (loss, (new_buffers, outs, diags)), grads = loss_and_grads(
+                params, buffers, data, label_vec, extras, epoch, rng)
+            params, opt_state, _ = apply_update(
+                (params, opt_state, grads), epoch)
+            return params, opt_state, new_buffers, loss, outs, diags
+
+        shardings_in = (self.param_shardings, self.opt_shardings,
+                        self.buffer_shardings,
+                        self.batch_shard, self.batch_shard,
+                        self.batch_shard, self.repl, self.repl)
+        shardings_out = (self.param_shardings, self.opt_shardings,
+                         self.buffer_shardings,
+                         self.repl, self.repl, self.repl)
+        return jax.jit(step, in_shardings=shardings_in,
+                       out_shardings=shardings_out,
+                       donate_argnums=(0, 1, 2))
+
+    def _get_eval_step(self, node_ids: Tuple[int, ...]):
+        if node_ids in self._eval_step_cache:
+            return self._eval_step_cache[node_ids]
+
+        def estep(params, buffers, data, extras):
+            nodes, _, _ = self._forward(params, buffers, data, None, extras,
+                                        train=False, rng=None, epoch=0)
+            return {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                    for nid in node_ids}
+
+        fn = jax.jit(estep,
+                     in_shardings=(self.param_shardings,
+                                   self.buffer_shardings,
+                                   self.batch_shard, self.batch_shard),
+                     out_shardings=self.repl)
+        self._eval_step_cache[node_ids] = fn
+        return fn
+
+    # ------------------------------------------------------------- training
+    def start_round(self, r: int) -> None:
+        self.round = r
+        self.train_metric.clear()
+
+    def _grad_acc_init(self):
+        return jax.tree.map(jnp.zeros_like, self.params)
+
+    def update(self, batch: DataBatch) -> None:
+        self.sample_counter += 1
+        do_update = (self.sample_counter % self.update_period == 0)
+        epoch = self.epoch_counter
+        if do_update:
+            self.epoch_counter += 1
+        rng = jax.random.fold_in(self._rng_base, self.sample_counter)
+        data = jnp.asarray(batch.data)
+        label_vec = jnp.asarray(batch.label, jnp.float32)
+        extras = tuple(jnp.asarray(e) for e in batch.extra_data)
+        if self.update_period > 1:
+            if getattr(self, "_grad_acc", None) is None:
+                self._grad_acc = self._grad_acc_init()
+            (self.params, self.opt_state, self.buffers, self._grad_acc,
+             loss, outs, diags) = self._train_step(
+                self.params, self.opt_state, self.buffers, self._grad_acc,
+                data, label_vec, extras,
+                jnp.int32(epoch), rng, jnp.bool_(do_update))
+        else:
+            (self.params, self.opt_state, self.buffers,
+             loss, outs, diags) = self._train_step(
+                self.params, self.opt_state, self.buffers,
+                data, label_vec, extras, jnp.int32(epoch), rng)
+        self._last_loss = loss
+        self._last_outs = outs
+        self._last_diags = diags
+        if self.train_metric.evals:
+            preds = [np.asarray(outs[nid]) for nid in self.eval_node_ids]
+            labels = {name: batch.label[:, a:b]
+                      for name, a, b in self._label_fields}
+            self.train_metric.add_eval(preds, labels)
+
+    def evaluate(self, data_iter, name: str) -> str:
+        self.metric.clear()
+        node_ids = tuple(dict.fromkeys(self.eval_node_ids))
+        estep = self._get_eval_step(node_ids)
+        for batch in data_iter:
+            outs = estep(self.params, self.buffers,
+                         jnp.asarray(batch.data),
+                         tuple(jnp.asarray(e) for e in batch.extra_data))
+            n_valid = batch.batch_size - batch.num_batch_padd
+            preds = [np.asarray(outs[nid])[:n_valid]
+                     for nid in self.eval_node_ids]
+            labels = {fname: batch.label[:n_valid, a:b]
+                      for fname, a, b in self._label_fields}
+            self.metric.add_eval(preds, labels)
+        return self.metric.print_line(name)
+
+    def train_eval_line(self, name: str = "train") -> str:
+        return self.train_metric.print_line(name)
+
+    # ------------------------------------------------------------ inference
+    def predict(self, batch: DataBatch) -> np.ndarray:
+        """Class predictions (argmax if multi-class) for one batch
+        (reference TransformPred, nnet_impl-inl.hpp:286-299)."""
+        raw = self.predict_raw(batch)
+        n_valid = batch.batch_size - batch.num_batch_padd
+        raw = raw[:n_valid]
+        if raw.shape[1] > 1:
+            return raw.argmax(axis=1).astype(np.float32)
+        return raw[:, 0]
+
+    def predict_raw(self, batch: DataBatch) -> np.ndarray:
+        nid = self.net.final_node
+        estep = self._get_eval_step((nid,))
+        outs = estep(self.params, self.buffers, jnp.asarray(batch.data),
+                     tuple(jnp.asarray(e) for e in batch.extra_data))
+        return np.asarray(outs[nid])
+
+    def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
+        nid = self.net.node_id(node_name)
+        estep = self._get_eval_step((nid,))
+        outs = estep(self.params, self.buffers, jnp.asarray(batch.data),
+                     tuple(jnp.asarray(e) for e in batch.extra_data))
+        n_valid = batch.batch_size - batch.num_batch_padd
+        return np.asarray(outs[nid])[:n_valid]
+
+    # ----------------------------------------------------------- weights IO
+    def _resolve_param_key(self, layer_name: str) -> str:
+        for conn in self.net.connections:
+            if conn.param_key.split("-", 1)[1] == layer_name:
+                return conn.param_key
+        raise KeyError(f"unknown layer name {layer_name!r}")
+
+    def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        return np.asarray(self.params[self._resolve_param_key(layer_name)][tag])
+
+    def set_weight(self, value: np.ndarray, layer_name: str, tag: str) -> None:
+        pkey = self._resolve_param_key(layer_name)
+        old = self.params[pkey][tag]
+        assert tuple(old.shape) == tuple(value.shape), \
+            f"set_weight: shape mismatch {old.shape} vs {value.shape}"
+        self.params[pkey][tag] = jax.device_put(
+            jnp.asarray(value, old.dtype), self.param_shardings[pkey][tag])
+
+    # ---------------------------------------------------------- checkpoints
+    def save_model(self, path: str, *, with_opt_state: bool = False) -> None:
+        serializer.save_model(
+            path, net_structure=self.netcfg.to_dict(),
+            epoch=self.epoch_counter,
+            params=jax.tree.map(np.asarray, self.params),
+            buffers=jax.tree.map(np.asarray, self.buffers),
+            opt_state=jax.tree.map(np.asarray, self.opt_state)
+            if with_opt_state else None,
+            extra_meta={"round": self.round})
+
+    def load_model(self, path: str) -> None:
+        header, params, buffers, opt = serializer.load_model(path)
+        netcfg = NetConfig.from_dict(header["net"])
+        # re-apply the current session's config on top of the checkpoint's:
+        # later pairs win inside set_param consumers, so CLI overrides like
+        # eta=... or updater=... take effect on continue/finetune (the
+        # reference re-broadcasts the live config the same way,
+        # cxxnet_main.cpp:205-212)
+        netcfg.defcfg = list(netcfg.defcfg) + [
+            (k, v) for (k, v) in self.cfg if not k.startswith("layer[")]
+        for k, v in self.cfg:
+            if k == "updater":
+                netcfg.updater_type = v
+        self.netcfg = netcfg
+        assert self.batch_size > 0, "batch_size must be set before load_model"
+        self.devices = meshlib.select_devices(self.dev)
+        if self.mesh_spec is None and len(self.devices) > 1:
+            self.mesh_spec = meshlib.MeshSpec({"data": len(self.devices)})
+        self.mesh = meshlib.build_mesh(self.devices, self.mesh_spec)
+        self.net = Network(netcfg, self.batch_size, self.dtype)
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.buffers = jax.tree.map(jnp.asarray, buffers)
+        self._rng_base = jax.random.PRNGKey(self.seed)
+        self._post_build()
+        self.epoch_counter = header["epoch"]
+        self.round = header["extra"].get("round", 0)
+        if opt is not None:
+            self.opt_state = jax.device_put(
+                jax.tree.map(jnp.asarray, opt), self.opt_shardings)
+
+    def copy_model_from(self, path: str) -> None:
+        """Finetune: copy weights for layers whose name and shapes match
+        (reference CopyModelFrom, nnet_impl-inl.hpp:101-134)."""
+        header, params, _, _ = serializer.load_model(path)
+        by_name = {k.split("-", 1)[1]: v for k, v in params.items()}
+        copied = []
+        for pkey, group in self.params.items():
+            name = pkey.split("-", 1)[1]
+            if name in by_name:
+                src = by_name[name]
+                if all(t in src and tuple(src[t].shape) == tuple(p.shape)
+                       for t, p in group.items()):
+                    self.params[pkey] = jax.device_put(
+                        {t: jnp.asarray(src[t], group[t].dtype)
+                         for t in group},
+                        self.param_shardings[pkey])
+                    copied.append(name)
+        if not self.silent:
+            print(f"copy_model_from: copied layers {copied}")
+
+    # ------------------------------------------------------------- checking
+    def check_weight_consistency(self) -> float:
+        """Replica-consistency check, the ``test_on_server`` equivalent
+        (async_updater-inl.hpp:144-154): max abs difference of any param
+        leaf across its replicas. 0.0 means all replicas agree."""
+        worst = 0.0
+        for leaf in jax.tree.leaves(self.params):
+            shards = getattr(leaf, "addressable_shards", None)
+            if not shards or len(shards) < 2:
+                continue
+            # group by slice index: only true replicas (same slice of the
+            # logical array) must be bit-identical
+            by_index = {}
+            for s in shards:
+                by_index.setdefault(str(s.index), []).append(s)
+            for group in by_index.values():
+                base = np.asarray(group[0].data)
+                for s in group[1:]:
+                    worst = max(worst, float(np.abs(
+                        np.asarray(s.data) - base).max()))
+        return worst
+
+
+def _group_tags(group: Dict) -> List[str]:
+    return list(group.keys())
+
+
+def _map_group(params, fn):
+    return {pkey: {tag: fn(tag, p) for tag, p in group.items()}
+            for pkey, group in params.items()}

@@ -1,0 +1,132 @@
+"""TPU-native functional ops used by the layer zoo.
+
+These play the role of mshadow's expression templates (``dot``, ``pool``,
+``chpool``, ``unpack_patch2col`` — see reference ``src/layer/*``): instead of
+lazily-evaluated CUDA expression trees, each op is a jax/lax function that XLA
+fuses and tiles onto the MXU/VPU.  Convolution is ``lax.conv_general_dilated``
+(the cuDNN/im2col analogue, reference ``convolution_layer-inl.hpp:70-155``),
+pooling is ``lax.reduce_window`` with the reference's tail-window shape rule,
+and LRN's cross-channel ``chpool`` is a windowed channel reduction.
+
+All arrays are logical NCHW (batch, channel, y, x), matching the reference's
+node layout (``layer.h:34-38``); XLA's layout assignment picks the physical
+TPU layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def pool_out_size(in_size: int, ksize: int, stride: int) -> int:
+    """Reference pooling output-size rule (pooling_layer-inl.hpp:103-106).
+
+    Includes a clipped tail window when (in-k) is not divisible by stride.
+    """
+    return min(in_size - ksize + stride - 1, in_size - 1) // stride + 1
+
+
+def conv_out_size(in_size: int, ksize: int, stride: int, pad: int) -> int:
+    """Reference conv output-size rule ((i + 2p - k) / s + 1)."""
+    return (in_size + 2 * pad - ksize) // stride + 1
+
+
+def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
+           pad_y: int = 0, pad_x: int = 0, num_group: int = 1,
+           ) -> jnp.ndarray:
+    """Grouped 2-D convolution, NCHW x OIHW -> NCHW.
+
+    Weight shape (out_c, in_c // num_group, kh, kw); the reference stores the
+    equivalent as a 3-D (group, out_c/group, in_c/group*kh*kw) tensor
+    (convolution_layer-inl.hpp:29-31).  Accumulates in float32 so bf16 inputs
+    still use full-precision MXU accumulation (XLA's default for bf16
+    operands on TPU; an explicit preferred_element_type would break the
+    conv transpose/grad rule's same-dtype requirement).
+    """
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=((pad_y, pad_y), (pad_x, pad_x)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=num_group,
+    )
+
+
+def _pool_padding(h: int, w: int, kh: int, kw: int, stride: int
+                  ) -> Tuple[Tuple[int, int], Tuple[int, int], int, int]:
+    oh = pool_out_size(h, kh, stride)
+    ow = pool_out_size(w, kw, stride)
+    pad_h = max(0, (oh - 1) * stride + kh - h)
+    pad_w = max(0, (ow - 1) * stride + kw - w)
+    return (0, pad_h), (0, pad_w), oh, ow
+
+
+def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
+               ) -> jnp.ndarray:
+    pad_h, pad_w, _, _ = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x, stride)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, ksize_y, ksize_x),
+        window_strides=(1, 1, stride, stride),
+        padding=((0, 0), (0, 0), pad_h, pad_w))
+
+
+def sum_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
+               ) -> jnp.ndarray:
+    pad_h, pad_w, _, _ = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x, stride)
+    return lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 1, ksize_y, ksize_x),
+        window_strides=(1, 1, stride, stride),
+        padding=((0, 0), (0, 0), pad_h, pad_w))
+
+
+def avg_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
+               ) -> jnp.ndarray:
+    """Average pooling; divides by the *full* kernel size even for clipped
+    tail windows, matching the reference (pooling_layer-inl.hpp:47-53)."""
+    s = sum_pool2d(x, ksize_y, ksize_x, stride)
+    return s * jnp.array(1.0 / (ksize_y * ksize_x), x.dtype)
+
+
+def chpool_sum(x: jnp.ndarray, nsize: int) -> jnp.ndarray:
+    """Cross-channel windowed sum (mshadow ``chpool<red::sum>``), centered
+    window of width ``nsize`` over the channel axis of NCHW."""
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    return lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, nsize, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
+
+
+def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float
+        ) -> jnp.ndarray:
+    """Local response normalization across channels
+    (reference lrn_layer-inl.hpp:53-56): out = x * (k + a/n * sum x^2)^-b."""
+    salpha = alpha / nsize
+    norm = chpool_sum(jnp.square(x), nsize) * salpha + knorm
+    return x * jnp.power(norm, -beta)
+
+
+def softmax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.softmax(x, axis=-1)
+
+
+def log_softmax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def dropout_mask(key: jax.Array, shape, pkeep: float, dtype=jnp.float32
+                 ) -> jnp.ndarray:
+    """Reference dropout mask: threshold(uniform, pkeep) / pkeep
+    (dropout_layer-inl.hpp:46-48)."""
+    u = jax.random.uniform(key, shape, dtype)
+    return (u < pkeep).astype(dtype) * (1.0 / pkeep)

@@ -1,0 +1,119 @@
+"""Device mesh + sharding: the TPU-native replacement for mshadow-ps.
+
+Reference: the multi-device path in ``src/nnet/nnet_impl-inl.hpp`` splits the
+batch across per-device threads and aggregates gradients via the
+``"local"``/``"dist"`` parameter server (InitParamServer :376-390,
+``async_updater-inl.hpp``).  Here the same data parallelism is one SPMD
+program over a ``jax.sharding.Mesh``: the batch is sharded on the ``data``
+axis, parameters are replicated (or sharded on ``model`` for the
+fullc_gather-style tensor-parallel mode), and XLA inserts the psum over ICI —
+no keys, no async callbacks, no server.  Multi-host runs the same program on
+a global mesh (DCN between hosts), which is the ``param_server = dist``
+equivalent.
+
+The axes are named, not hard-coded to "batch", so sequence/context/expert
+axes can attach later (survey §5.7 note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def parse_device_spec(dev: str) -> Dict:
+    """Parse ``dev = cpu | tpu | tpu:0 | tpu:0-3 | gpu:1,3`` (reference
+    nnet_impl-inl.hpp:32-51 parses the gpu:0-3 form)."""
+    dev = dev.strip()
+    if ":" not in dev:
+        return {"platform": dev, "ids": None}
+    platform, rng = dev.split(":", 1)
+    ids: List[int] = []
+    for part in rng.split(","):
+        if "-" in part:
+            a, b = part.split("-")
+            ids.extend(range(int(a), int(b) + 1))
+        else:
+            ids.append(int(part))
+    return {"platform": platform, "ids": ids}
+
+
+def select_devices(dev: str) -> List[jax.Device]:
+    spec = parse_device_spec(dev)
+    platform = spec["platform"]
+    if platform == "cpu":
+        # force the CPU backend before any backend initializes; environments
+        # that tunnel a TPU pin JAX_PLATFORMS, so plain env vars don't stick
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backends already initialized; fall through to selection
+    if platform in ("tpu", "gpu", "cpu"):
+        try:
+            devices = jax.devices(platform)
+        except RuntimeError:
+            devices = jax.devices()  # axon/tunnel platforms report differently
+    else:
+        devices = jax.devices()
+    if spec["ids"] is None:
+        return list(devices[:1])
+    for i in spec["ids"]:
+        if i >= len(devices):
+            raise ValueError(
+                f"device id {i} out of range: only {len(devices)} "
+                f"{platform} devices visible")
+    return [devices[i] for i in spec["ids"]]
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Named mesh axes, e.g. {"data": 4, "model": 2}."""
+
+    axes: Dict[str, int]
+
+    @classmethod
+    def parse(cls, s: str) -> "MeshSpec":
+        """Parse ``mesh = data:4,model:2`` config syntax."""
+        axes: Dict[str, int] = {}
+        for part in s.split(","):
+            name, size = part.split(":")
+            axes[name.strip()] = int(size)
+        return cls(axes)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for v in self.axes.values():
+            n *= v
+        return n
+
+
+def build_mesh(devices: Sequence[jax.Device],
+               spec: Optional[MeshSpec] = None) -> Mesh:
+    """Build a Mesh; default one-axis "data" mesh over all given devices."""
+    if spec is None:
+        spec = MeshSpec({"data": len(devices)})
+    assert spec.size == len(devices), \
+        f"mesh axes {spec.axes} need {spec.size} devices, got {len(devices)}"
+    arr = np.array(devices).reshape(tuple(spec.axes.values()))
+    return Mesh(arr, tuple(spec.axes.keys()))
+
+
+def batch_pspec(mesh: Mesh) -> P:
+    """Batch dim sharded over "data" (if present), rest replicated."""
+    if "data" in mesh.axis_names:
+        return P("data")
+    return P()
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec(mesh))

@@ -1,0 +1,5 @@
+from .updaters import (UpdaterHyper, create_updater, SGDUpdater, NAGUpdater,
+                       AdamUpdater)
+
+__all__ = ["UpdaterHyper", "create_updater", "SGDUpdater", "NAGUpdater",
+           "AdamUpdater"]

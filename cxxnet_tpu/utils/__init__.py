@@ -1,0 +1,5 @@
+from .config import (ConfigError, parse_config_file, parse_config_string,
+                     parse_keyval_args)
+
+__all__ = ["ConfigError", "parse_config_file", "parse_config_string",
+           "parse_keyval_args"]

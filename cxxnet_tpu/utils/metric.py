@@ -1,0 +1,139 @@
+"""Evaluation metrics: rmse / error / logloss / rec@n + MetricSet.
+
+Reference: ``src/utils/metric.h:20-236``.  Metrics run on the host over
+numpy copies of eval-requested node outputs, excluding ``num_batch_padd``
+padding instances (reference nnet_impl-inl.hpp:237-240).  Output format
+parity: ``\\tname-metric:value`` fragments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Metric:
+    name = ""
+
+    def __init__(self):
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def clear(self):
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def add_eval(self, pred: np.ndarray, label: np.ndarray) -> None:
+        """pred (n, k) scores, label (n, label_width)."""
+        vals = self._calc(pred.astype(np.float64), label.astype(np.float64))
+        self.sum_metric += float(vals.sum())
+        self.cnt_inst += pred.shape[0]
+
+    def get(self) -> float:
+        return self.sum_metric / max(self.cnt_inst, 1)
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class MetricRMSE(Metric):
+    name = "rmse"
+
+    def _calc(self, pred, label):
+        assert pred.shape[1] == label.shape[1], \
+            "rmse: prediction and label sizes must match"
+        return np.square(pred - label).sum(axis=1)
+
+
+class MetricError(Metric):
+    """argmax error for multi-class scores; threshold-at-0 for single column
+    (metric.h MetricError)."""
+
+    name = "error"
+
+    def _calc(self, pred, label):
+        if pred.shape[1] != 1:
+            maxidx = pred.argmax(axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(np.int64)
+        return (maxidx != label[:, 0].astype(np.int64)).astype(np.float64)
+
+
+class MetricLogloss(Metric):
+    name = "logloss"
+
+    def _calc(self, pred, label):
+        eps = 1e-15
+        if pred.shape[1] != 1:
+            tgt = label[:, 0].astype(np.int64)
+            p = np.clip(pred[np.arange(len(tgt)), tgt], eps, 1 - eps)
+            return -np.log(p)
+        p = np.clip(pred[:, 0], eps, 1 - eps)
+        y = label[:, 0]
+        res = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        assert not np.isnan(res).any(), "NaN detected!"
+        return res
+
+
+class MetricRecall(Metric):
+    """rec@n with random tie-break shuffle (metric.h MetricRecall)."""
+
+    def __init__(self, name: str):
+        super().__init__()
+        assert name.startswith("rec@"), "must specify n for rec@n"
+        self.name = name
+        self.topn = int(name[4:])
+        self._rng = np.random.RandomState(0)
+
+    def _calc(self, pred, label):
+        n, k = pred.shape
+        assert k >= self.topn, \
+            f"rec@{self.topn} meaningless for score list of length {k}"
+        out = np.zeros(n)
+        for i in range(n):
+            order = self._rng.permutation(k)
+            top = order[np.argsort(-pred[i, order], kind="stable")][:self.topn]
+            hits = np.isin(top, label[i].astype(np.int64)).sum()
+            out[i] = hits / label.shape[1]
+        return out
+
+
+def create_metric(name: str) -> Metric:
+    if name == "rmse":
+        return MetricRMSE()
+    if name == "error":
+        return MetricError()
+    if name == "logloss":
+        return MetricLogloss()
+    if name.startswith("rec@"):
+        return MetricRecall(name)
+    raise ValueError(f"unknown metric {name!r}")
+
+
+class MetricSet:
+    """Set of (metric, label-field) bindings (metric.h MetricSet)."""
+
+    def __init__(self):
+        self.evals: List[Metric] = []
+        self.label_fields: List[str] = []
+
+    def add_metric(self, name: str, label_field: str) -> None:
+        for m, f in zip(self.evals, self.label_fields):
+            if m.name == name and f == label_field:
+                return
+        self.evals.append(create_metric(name))
+        self.label_fields.append(label_field)
+
+    def clear(self):
+        for m in self.evals:
+            m.clear()
+
+    def add_eval(self, predscores: List[np.ndarray],
+                 labels: Dict[str, np.ndarray]) -> None:
+        """predscores[i] pairs with self.evals[i]."""
+        for m, f, p in zip(self.evals, self.label_fields, predscores):
+            m.add_eval(p, labels[f])
+
+    def print_line(self, evname: str) -> str:
+        return "".join(f"\t{evname}-{m.name}:{m.get():f}" for m in self.evals)

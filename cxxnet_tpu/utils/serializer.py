@@ -1,0 +1,77 @@
+"""Checkpoint serialization: single-file model format.
+
+Reference format (survey §5.4): ``net_type`` int + NetConfig::SaveNet
+(structure) + epoch counter + concatenated per-layer weight blobs
+(``nnet_impl-inl.hpp:82-87``), with ``reserved[]`` padding for forward
+compatibility.  Our format keeps the same *content* in a self-describing
+container: one ``.model`` file = numpy ``.npz`` holding a JSON header
+(format version, net structure dict, epoch, dtype) plus every tensor under a
+flattened ``group/key`` name.  Forward compatibility comes from the JSON
+header rather than reserved struct bytes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _flatten(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
+    tree: Dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = tree
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return tree
+
+
+def save_model(path: str, *, net_structure: dict, epoch: int,
+               params: Dict, buffers: Dict, opt_state: Dict = None,
+               extra_meta: Dict = None) -> None:
+    header = {
+        "format_version": FORMAT_VERSION,
+        "net": net_structure,
+        "epoch": int(epoch),
+        "has_opt_state": opt_state is not None,
+        "extra": extra_meta or {},
+    }
+    arrays: Dict[str, np.ndarray] = {
+        "__header__": np.frombuffer(
+            json.dumps(header).encode("utf-8"), dtype=np.uint8),
+    }
+    arrays.update(_flatten({"params": params}))
+    arrays.update(_flatten({"buffers": buffers}))
+    if opt_state is not None:
+        arrays.update(_flatten({"opt": opt_state}))
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_model(path: str) -> Tuple[dict, Dict, Dict, Dict]:
+    """Return (header, params, buffers, opt_state_or_None)."""
+    with np.load(path, allow_pickle=False) as z:
+        header = json.loads(bytes(z["__header__"]).decode("utf-8"))
+        flat = {k: z[k] for k in z.files if k != "__header__"}
+    tree = _unflatten(flat)
+    params = tree.get("params", {})
+    buffers = tree.get("buffers", {})
+    opt = tree.get("opt") if header.get("has_opt_state") else None
+    return header, params, buffers, opt

@@ -1,0 +1,150 @@
+"""Config tokenizer + NetConfig parsing tests."""
+
+import pytest
+
+from cxxnet_tpu.utils.config import (ConfigError, parse_config_string,
+                                     parse_keyval_args)
+from cxxnet_tpu.nnet.netconfig import NetConfig
+
+
+def test_basic_pairs():
+    pairs = parse_config_string("a = 1\nb=2\n# comment\nc = hello\n")
+    assert pairs == [("a", "1"), ("b", "2"), ("c", "hello")]
+
+
+def test_quoted_values():
+    pairs = parse_config_string('path = "./data/my file.gz"\n')
+    assert pairs == [("path", "./data/my file.gz")]
+
+
+def test_order_and_repeats():
+    pairs = parse_config_string("iter = mnist\niter = end\niter = mnist\n")
+    assert [v for _, v in pairs] == ["mnist", "end", "mnist"]
+
+
+def test_inline_comment_and_ws():
+    pairs = parse_config_string("x  =  3   # trailing\n  y=z\n")
+    assert pairs == [("x", "3"), ("y", "z")]
+
+
+def test_keyval_args():
+    assert parse_keyval_args(["dev=tpu", "num_round=3"]) == \
+        [("dev", "tpu"), ("num_round", "3")]
+    with pytest.raises(ConfigError):
+        parse_keyval_args(["noequals"])
+
+
+MLP_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 16
+"""
+
+
+def test_netconfig_mlp():
+    nc = NetConfig()
+    nc.configure(parse_config_string(MLP_CONF))
+    assert len(nc.layers) == 4
+    assert nc.layers[0].type_name == "fullc"
+    assert nc.layers[0].nindex_in == [0]
+    # fc1 output node is a new node named fc1
+    fc1_out = nc.layers[0].nindex_out[0]
+    assert nc.node_names[fc1_out] == "fc1"
+    # sigmoid reads from fc1's out
+    assert nc.layers[1].nindex_in == [fc1_out]
+    # layer[sg1->fc2] named-node wiring
+    sg1 = nc.node_name_map["sg1"]
+    assert nc.layers[2].nindex_in == [sg1]
+    # softmax is a self-loop (layer[+0])
+    assert nc.layers[3].nindex_in == nc.layers[3].nindex_out
+    # captured layer config
+    assert ("nhidden", "100") in nc.layercfg[0]
+    assert nc.input_shape == (1, 1, 784)
+    assert nc.layer_name_map["fc1"] == 0
+
+
+def test_netconfig_numeric_nodes():
+    conf = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+layer[1->2] = max_pooling
+  kernel_size = 2
+layer[2->2] = dropout
+netconfig=end
+input_shape = 1,28,28
+"""
+    nc = NetConfig()
+    nc.configure(parse_config_string(conf))
+    assert nc.num_nodes == 3
+    assert nc.layers[2].nindex_in == nc.layers[2].nindex_out == [2]
+
+
+def test_netconfig_multi_input():
+    conf = """
+netconfig=start
+layer[0->a] = fullc:f1
+  nhidden = 8
+layer[0->b] = fullc:f2
+  nhidden = 8
+layer[a,b->c] = concat
+layer[+1] = softmax
+netconfig=end
+input_shape = 1,1,4
+"""
+    nc = NetConfig()
+    nc.configure(parse_config_string(conf))
+    assert len(nc.layers[2].nindex_in) == 2
+    # layer[+1] allocates an anonymous node after c
+    assert nc.layers[3].nindex_in == [nc.node_name_map["c"]]
+
+
+def test_netconfig_share_layer():
+    conf = """
+netconfig=start
+layer[0->x] = fullc:enc
+  nhidden = 4
+layer[x->y] = sigmoid
+layer[y->z] = share[enc]
+netconfig=end
+input_shape = 1,1,4
+"""
+    nc = NetConfig()
+    nc.configure(parse_config_string(conf))
+    assert nc.layers[2].is_shared
+    assert nc.layers[2].primary_layer_index == 0
+
+
+def test_netconfig_label_vec():
+    conf = """
+label_vec[0,1) = label
+label_vec[1,4) = extra_label
+netconfig=start
+layer[+1] = fullc
+  nhidden = 4
+netconfig=end
+input_shape = 1,1,4
+"""
+    nc = NetConfig()
+    nc.configure(parse_config_string(conf))
+    fields = dict((n, (a, b)) for n, a, b in nc.label_fields())
+    assert fields == {"label": (0, 1), "extra_label": (1, 4)}
+    assert nc.label_width() == 4
+
+
+def test_netconfig_roundtrip():
+    nc = NetConfig()
+    nc.configure(parse_config_string(MLP_CONF))
+    d = nc.to_dict()
+    nc2 = NetConfig.from_dict(d)
+    assert nc2.node_names == nc.node_names
+    assert [l.type_name for l in nc2.layers] == \
+        [l.type_name for l in nc.layers]
+    assert nc2.layercfg == nc.layercfg

@@ -1,0 +1,238 @@
+"""I/O pipeline tests: mnist reader, batch adapter round_batch protocol,
+threadbuffer prefetch, membuffer, attachtxt join, imbin pack/read round trip,
+iterator chain factory, determinism."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch, DataInst, IIterator
+from cxxnet_tpu.io.factory import create_iterator, init_iterator
+from cxxnet_tpu.io.iter_proc import (AttachTxtIterator, BatchAdaptIterator,
+                                     DenseBufferIterator,
+                                     ThreadBufferIterator)
+
+
+class ListInstIterator(IIterator):
+    """Test helper: instance iterator over given arrays."""
+
+    def __init__(self, data, labels):
+        self.data = data
+        self.labels = labels
+        self.pos = 0
+
+    def before_first(self):
+        self.pos = 0
+
+    def next(self):
+        if self.pos >= len(self.data):
+            return None
+        i = self.pos
+        self.pos += 1
+        return DataInst(label=np.atleast_1d(self.labels[i]),
+                        data=self.data[i], index=i)
+
+
+def make_insts(n, shape=(1, 4, 4)):
+    rnd = np.random.RandomState(0)
+    return rnd.rand(n, *shape).astype(np.float32), \
+        rnd.randint(0, 3, n).astype(np.float32)
+
+
+def test_batch_adapter_drops_tail_by_default():
+    data, labels = make_insts(10)
+    it = BatchAdaptIterator(ListInstIterator(data, labels))
+    it.set_param("batch_size", "4")
+    it.init()
+    batches = list(it)
+    assert len(batches) == 2
+    assert all(b.batch_size == 4 for b in batches)
+
+
+def test_batch_adapter_round_batch_wraps_and_terminates():
+    data, labels = make_insts(10)
+    it = BatchAdaptIterator(ListInstIterator(data, labels))
+    it.set_param("batch_size", "4")
+    it.set_param("round_batch", "1")
+    it.init()
+    batches = list(it)
+    assert len(batches) == 3, "round_batch epoch must end after the wrap batch"
+    assert batches[2].num_batch_padd == 2
+    # the wrapped instances are the first two of the epoch
+    np.testing.assert_allclose(batches[2].data[-2:], data[:2])
+    # second epoch works identically
+    batches2 = list(it)
+    assert len(batches2) == 3
+
+
+def test_batch_adapter_test_skipread():
+    data, labels = make_insts(8)
+    it = BatchAdaptIterator(ListInstIterator(data, labels))
+    it.set_param("batch_size", "4")
+    it.set_param("test_skipread", "1")
+    it.init()
+    it.before_first()
+    b1 = it.next()
+    b2 = it.next()
+    assert b1 is b2, "test_skipread must return the cached batch"
+
+
+def test_threadbuffer_preserves_stream_and_restarts():
+    data, labels = make_insts(12)
+    base = BatchAdaptIterator(ListInstIterator(data, labels))
+    base.set_param("batch_size", "4")
+    it = ThreadBufferIterator(base)
+    it.init()
+    for _ in range(3):  # several epochs incl. restart mid-epoch
+        it.before_first()
+        seen = [it.next() for _ in range(2)]
+        assert all(b is not None for b in seen)
+    it.before_first()
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data, data[:4])
+
+
+def test_membuffer_caches_and_loops():
+    data, labels = make_insts(12)
+    base = BatchAdaptIterator(ListInstIterator(data, labels))
+    base.set_param("batch_size", "4")
+    it = DenseBufferIterator(base)
+    it.set_param("max_nbatch", "2")
+    it.init()
+    first = list(it)
+    assert len(first) == 2
+    second = list(it)
+    assert len(second) == 2
+    np.testing.assert_allclose(first[0].data, second[0].data)
+
+
+def test_attachtxt_joins_extra_data(tmp_path):
+    data, labels = make_insts(8)
+    txt = tmp_path / "extra.txt"
+    with open(txt, "w") as f:
+        for i in range(8):
+            f.write(f"{i} {i * 1.0} {i * 2.0}\n")
+    base = BatchAdaptIterator(ListInstIterator(data, labels))
+    base.set_param("batch_size", "4")
+    it = AttachTxtIterator(base)
+    it.set_param("path_attach_txt", str(txt))
+    it.set_param("extra_data_shape[0]", "1,1,2")
+    it.init()
+    it.before_first()
+    b = it.next()
+    assert len(b.extra_data) == 1
+    assert b.extra_data[0].shape == (4, 1, 1, 2)
+    np.testing.assert_allclose(b.extra_data[0][2, 0, 0], [2.0, 4.0])
+
+
+def test_mnist_iterator(tmp_path):
+    from cxxnet_tpu.io.iter_mnist import MNISTIterator
+    img_path = tmp_path / "img.gz"
+    lab_path = tmp_path / "lab.gz"
+    rnd = np.random.RandomState(0)
+    imgs = (rnd.rand(25, 5, 5) * 255).astype(np.uint8)
+    labs = rnd.randint(0, 10, 25).astype(np.uint8)
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, 25, 5, 5))
+        f.write(imgs.tobytes())
+    with gzip.open(lab_path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, 25))
+        f.write(labs.tobytes())
+    it = MNISTIterator()
+    it.set_param("path_img", str(img_path))
+    it.set_param("path_label", str(lab_path))
+    it.set_param("batch_size", "10")
+    it.set_param("silent", "1")
+    it.init()
+    batches = list(it)
+    assert len(batches) == 2  # tail of 5 dropped
+    np.testing.assert_allclose(
+        batches[0].data.reshape(10, 25),
+        imgs[:10].reshape(10, 25).astype(np.float32) / 256.0)
+    assert batches[0].label[3, 0] == labs[3]
+    # round_batch pads
+    it.set_param("round_batch", "1")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[2].num_batch_padd == 5
+
+
+def _fake_jpegs(tmp_path, n=10):
+    """Tiny real jpegs via cv2 so the decode path is exercised."""
+    import cv2
+    root = tmp_path / "imgs"
+    os.makedirs(root, exist_ok=True)
+    lst = tmp_path / "list.lst"
+    rnd = np.random.RandomState(0)
+    with open(lst, "w") as f:
+        for i in range(n):
+            img = (rnd.rand(8, 8, 3) * 255).astype(np.uint8)
+            cv2.imwrite(str(root / f"{i}.jpg"), img)
+            f.write(f"{i}\t{i % 3}\t{i}.jpg\n")
+    return root, lst
+
+
+def test_imbin_pack_and_iterate(tmp_path):
+    from cxxnet_tpu.io.imbin import ImageBinIterator, pack_imbin
+    root, lst = _fake_jpegs(tmp_path)
+    out = tmp_path / "pack.bin"
+    n = pack_imbin(str(lst), str(root), str(out), page_size=1 << 14)
+    assert n == 10
+    it = ImageBinIterator()
+    it.set_param("path_imgbin", str(out))
+    it.set_param("path_imglst", str(lst))
+    it.set_param("silent", "1")
+    it.init()
+    insts = list(it)
+    assert len(insts) == 10
+    assert insts[0].data.shape == (3, 8, 8)
+    assert [int(i.label[0]) for i in insts] == [i % 3 for i in range(10)]
+    # second epoch identical
+    insts2 = list(it)
+    assert len(insts2) == 10
+
+
+def test_imbin_shuffle_keeps_label_pairing(tmp_path):
+    """Regression: shuffle must permute image and label together."""
+    from cxxnet_tpu.io.imbin import ImageBinIterator, pack_imbin
+    import cv2
+    root = tmp_path / "imgs"
+    os.makedirs(root, exist_ok=True)
+    lst = tmp_path / "list.lst"
+    # image i is a constant image of value 20*i; label = i
+    with open(lst, "w") as f:
+        for i in range(10):
+            img = np.full((8, 8, 3), i * 20, np.uint8)
+            cv2.imwrite(str(root / f"{i}.png"), img)  # png = lossless
+            f.write(f"{i}\t{i}\t{i}.png\n")
+    out = tmp_path / "pack.bin"
+    pack_imbin(str(lst), str(root), str(out), page_size=1 << 13)
+    it = ImageBinIterator()
+    it.set_param("path_imgbin", str(out))
+    it.set_param("path_imglst", str(lst))
+    it.set_param("shuffle", "1")
+    it.set_param("silent", "1")
+    it.init()
+    insts = list(it)
+    assert len(insts) == 10
+    order = []
+    for inst in insts:
+        val = int(round(inst.data.mean() / 20.0))
+        assert int(inst.label[0]) == val, "label/image pairing broken"
+        order.append(val)
+    assert sorted(order) == list(range(10))
+
+
+def test_iterator_chain_factory():
+    cfg = [("iter", "mnist"), ("batch_size", "4"), ("iter", "threadbuffer"),
+           ("iter", "end")]
+    it = create_iterator(cfg)
+    assert isinstance(it, ThreadBufferIterator)
+    from cxxnet_tpu.io.iter_mnist import MNISTIterator
+    assert isinstance(it.base, MNISTIterator)
+    with pytest.raises(ValueError):
+        create_iterator([("iter", "bogus")])

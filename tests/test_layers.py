@@ -1,0 +1,395 @@
+"""Layer zoo unit tests against numpy oracles.
+
+This is the PairTest-style differential strategy from the reference
+(pairtest_layer-inl.hpp) turned into a real unit suite: each TPU/XLA layer
+is checked against an independent numpy implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.layers.base import ForwardContext, LabelInfo
+from cxxnet_tpu.layers.registry import create_layer
+from cxxnet_tpu.ops import nn as N
+
+
+def ctx_eval():
+    return ForwardContext(train=False)
+
+
+def ctx_train(seed=0):
+    return ForwardContext(train=True, rng=jax.random.PRNGKey(seed))
+
+
+def run_layer(type_name, x, cfg=None, train=False, in_shapes=None, seed=0):
+    layer = create_layer(type_name)
+    for k, v in (cfg or {}).items():
+        layer.set_param(k, str(v))
+    xs = x if isinstance(x, list) else [x]
+    shapes = in_shapes or [tuple(a.shape) for a in xs]
+    out_shapes = layer.infer_shapes(shapes)
+    params = layer.init_params(jax.random.PRNGKey(42), shapes)
+    buffers = layer.init_buffers(shapes)
+    ctx = ctx_train(seed) if train else ctx_eval()
+    outs, _ = layer.forward(params, buffers,
+                            [jnp.asarray(a) for a in xs], ctx)
+    for o, s in zip(outs, out_shapes):
+        assert tuple(o.shape) == s, f"{type_name}: shape {o.shape} != {s}"
+    return [np.asarray(o) for o in outs], params
+
+
+def rand4(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- activations
+def test_relu_sigmoid_tanh_softplus():
+    x = rand4(2, 3, 4, 5)
+    (y,), _ = run_layer("relu", x)
+    np.testing.assert_allclose(y, np.maximum(x, 0), rtol=1e-6)
+    (y,), _ = run_layer("sigmoid", x)
+    np.testing.assert_allclose(y, 1 / (1 + np.exp(-x)), rtol=1e-5)
+    (y,), _ = run_layer("tanh", x)
+    np.testing.assert_allclose(y, np.tanh(x), rtol=1e-5)
+    (y,), _ = run_layer("softplus", x)
+    np.testing.assert_allclose(y, np.log1p(np.exp(x)), rtol=1e-5)
+
+
+def test_xelu():
+    x = rand4(2, 1, 1, 8)
+    (y,), _ = run_layer("xelu", x, {"b": 4.0})
+    np.testing.assert_allclose(y, np.where(x > 0, x, x / 4.0), rtol=1e-6)
+
+
+def test_insanity_eval_uses_mean_slope():
+    x = rand4(2, 1, 1, 8)
+    (y,), _ = run_layer("insanity", x, {"lb": 2, "ub": 4})
+    np.testing.assert_allclose(y, np.where(x > 0, x, x / 3.0), rtol=1e-6)
+
+
+def test_insanity_train_bounds():
+    x = -np.ones((4, 1, 1, 64), np.float32)
+    (y,), _ = run_layer("insanity", x, {"lb": 2, "ub": 4}, train=True)
+    # each element is -1/d with d in [2,4]
+    assert ((y <= -1 / 4.001) & (y >= -1 / 1.999)).all()
+
+
+def test_prelu_eval():
+    x = rand4(2, 3, 4, 4)
+    (y,), params = run_layer("prelu", x, {"init_slope": 0.25})
+    slope = np.asarray(params["bias"])
+    assert slope.shape == (3,)
+    expect = np.where(x > 0, x, x * slope.reshape(1, 3, 1, 1))
+    np.testing.assert_allclose(y, expect, rtol=1e-6)
+
+
+def test_bias_layer():
+    x = rand4(2, 1, 1, 6)
+    (y,), params = run_layer("bias", x, {"init_bias": 0.5})
+    np.testing.assert_allclose(y, x + 0.5, rtol=1e-6)
+
+
+# --------------------------------------------------------------------- fullc
+def test_fullc_matches_numpy():
+    x = rand4(4, 1, 1, 10)
+    (y,), params = run_layer("fullc", x, {"nhidden": 7})
+    w = np.asarray(params["wmat"])
+    b = np.asarray(params["bias"])
+    expect = x.reshape(4, 10) @ w.T + b
+    np.testing.assert_allclose(y.reshape(4, 7), expect, rtol=1e-4)
+
+
+def test_fullc_no_bias_and_init():
+    x = rand4(4, 1, 1, 10)
+    (y,), params = run_layer("fullc", x,
+                             {"nhidden": 7, "no_bias": 1,
+                              "random_type": "xavier"})
+    assert "bias" not in params
+    w = np.asarray(params["wmat"])
+    bound = np.sqrt(3.0 / (10 + 7))
+    assert np.abs(w).max() <= bound + 1e-6
+
+
+def test_fixconn(tmp_path):
+    p = tmp_path / "w.txt"
+    p.write_text("3 4 2\n0 1 2.0\n2 3 -1.0\n")
+    x = rand4(2, 1, 1, 4)
+    (y,), _ = run_layer("fixconn", x,
+                        {"nhidden": 3, "fixconn_weight": str(p)})
+    w = np.zeros((3, 4), np.float32)
+    w[0, 1] = 2.0
+    w[2, 3] = -1.0
+    np.testing.assert_allclose(y.reshape(2, 3), x.reshape(2, 4) @ w.T,
+                               rtol=1e-5)
+
+
+# ----------------------------------------------------------------------- conv
+def conv_ref(x, w, b, stride, pad, groups=1):
+    n, c, h, ww = x.shape
+    oc, icg, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (ww + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, oc, oh, ow), np.float32)
+    cg = c // groups
+    ocg = oc // groups
+    for g in range(groups):
+        for o in range(g * ocg, (g + 1) * ocg):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[:, g * cg:(g + 1) * cg,
+                               i * stride:i * stride + kh,
+                               j * stride:j * stride + kw]
+                    out[:, o, i, j] = (patch * w[o]).sum(axis=(1, 2, 3))
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def test_conv_matches_reference_impl():
+    x = rand4(2, 3, 8, 8)
+    (y,), params = run_layer("conv", x,
+                             {"nchannel": 4, "kernel_size": 3, "stride": 2,
+                              "pad": 1})
+    expect = conv_ref(x, np.asarray(params["wmat"]),
+                      np.asarray(params["bias"]), 2, 1)
+    np.testing.assert_allclose(y, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_grouped_conv():
+    x = rand4(2, 4, 6, 6)
+    (y,), params = run_layer("conv", x,
+                             {"nchannel": 6, "kernel_size": 3, "ngroup": 2,
+                              "no_bias": 1})
+    expect = conv_ref(x, np.asarray(params["wmat"]), None, 1, 0, groups=2)
+    np.testing.assert_allclose(y, expect, rtol=1e-3, atol=1e-4)
+
+
+# -------------------------------------------------------------------- pooling
+def pool_ref(x, k, s, mode):
+    n, c, h, w = x.shape
+    oh = min(h - k + s - 1, h - 1) // s + 1
+    ow = min(w - k + s - 1, w - 1) // s + 1
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = x[:, :, i * s:min(i * s + k, h), j * s:min(j * s + k, w)]
+            if mode == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            elif mode == "sum":
+                out[:, :, i, j] = win.sum(axis=(2, 3))
+            else:
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / (k * k)
+    return out
+
+
+@pytest.mark.parametrize("mode,layer", [("max", "max_pooling"),
+                                        ("sum", "sum_pooling"),
+                                        ("avg", "avg_pooling")])
+@pytest.mark.parametrize("hw,k,s", [(6, 2, 2), (7, 3, 2), (28, 3, 2)])
+def test_pooling(mode, layer, hw, k, s):
+    x = rand4(2, 3, hw, hw)
+    (y,), _ = run_layer(layer, x, {"kernel_size": k, "stride": s})
+    np.testing.assert_allclose(y, pool_ref(x, k, s, mode),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_relu_max_pooling():
+    x = rand4(2, 3, 6, 6)
+    (y,), _ = run_layer("relu_max_pooling", x, {"kernel_size": 2, "stride": 2})
+    np.testing.assert_allclose(y, pool_ref(np.maximum(x, 0), 2, 2, "max"),
+                               rtol=1e-6)
+
+
+def test_insanity_pooling_eval_is_max_pool():
+    x = rand4(2, 3, 6, 6)
+    (y,), _ = run_layer("insanity_max_pooling", x,
+                        {"kernel_size": 2, "stride": 2})
+    np.testing.assert_allclose(y, pool_ref(x, 2, 2, "max"), rtol=1e-6)
+
+
+# ------------------------------------------------------------------------ lrn
+def lrn_ref(x, nsize, alpha, beta, knorm):
+    n, c, h, w = x.shape
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    out = np.zeros_like(x)
+    for ci in range(c):
+        a = max(0, ci - lo)
+        b = min(c, ci + hi + 1)
+        norm = (x[:, a:b] ** 2).sum(axis=1) * (alpha / nsize) + knorm
+        out[:, ci] = x[:, ci] * norm ** (-beta)
+    return out
+
+
+def test_lrn():
+    x = rand4(2, 8, 4, 4)
+    (y,), _ = run_layer("lrn", x, {"local_size": 5, "alpha": 0.001,
+                                   "beta": 0.75, "knorm": 1.0})
+    np.testing.assert_allclose(y, lrn_ref(x, 5, 0.001, 0.75, 1.0),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ----------------------------------------------------------------- batch_norm
+def test_batch_norm_conv_branch():
+    x = rand4(8, 3, 4, 4)
+    (y,), _ = run_layer("batch_norm", x, {"eps": 1e-5})
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+    expect = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(y, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_batch_norm_fc_branch():
+    x = rand4(16, 1, 1, 6)
+    (y,), _ = run_layer("batch_norm", x, {"eps": 1e-5})
+    mean = x.mean(axis=(0, 1, 2), keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=(0, 1, 2), keepdims=True)
+    np.testing.assert_allclose(y, (x - mean) / np.sqrt(var + 1e-5),
+                               rtol=1e-3, atol=1e-4)
+
+
+# -------------------------------------------------------------------- dropout
+def test_dropout_eval_is_identity():
+    x = rand4(2, 1, 1, 16)
+    (y,), _ = run_layer("dropout", x, {"threshold": 0.5})
+    np.testing.assert_allclose(y, x)
+
+
+def test_dropout_train_mask_and_scale():
+    x = np.ones((8, 1, 1, 1000), np.float32)
+    (y,), _ = run_layer("dropout", x, {"threshold": 0.5}, train=True)
+    vals = np.unique(np.round(y, 4))
+    assert set(vals).issubset({0.0, 2.0})
+    assert abs((y != 0).mean() - 0.5) < 0.05
+
+
+# ------------------------------------------------------------------ shape ops
+def test_flatten():
+    x = rand4(2, 3, 4, 5)
+    (y,), _ = run_layer("flatten", x)
+    np.testing.assert_allclose(y.reshape(2, -1), x.reshape(2, -1))
+
+
+def test_split_and_concat():
+    x = rand4(2, 1, 1, 6)
+    layer = create_layer("split")
+    layer.num_out = 2
+    outs, _ = layer.forward({}, {}, [jnp.asarray(x)], ctx_eval())
+    assert len(outs) == 2
+    a, b = rand4(2, 1, 1, 3), rand4(2, 1, 1, 5, seed=1)
+    (y,), _ = run_layer("concat", [a, b])
+    np.testing.assert_allclose(y, np.concatenate([a, b], axis=3))
+    a, b = rand4(2, 3, 4, 4), rand4(2, 5, 4, 4, seed=1)
+    (y,), _ = run_layer("ch_concat", [a, b])
+    np.testing.assert_allclose(y, np.concatenate([a, b], axis=1))
+
+
+def test_maxout():
+    x = rand4(2, 6, 4, 4)
+    (y,), _ = run_layer("maxout", x, {"ngroup": 3})
+    expect = x.reshape(2, 2, 3, 4, 4).max(axis=2)
+    np.testing.assert_allclose(y, expect)
+
+
+# ---------------------------------------------------------------------- loss
+def test_softmax_forward_and_loss():
+    x = rand4(4, 1, 1, 10)
+    layer = create_layer("softmax")
+    layer.set_param("batch_size", "4")
+    labels = LabelInfo(fields={"label": jnp.asarray(
+        np.array([[1.0], [3.0], [0.0], [7.0]], np.float32))})
+    ctx = ForwardContext(train=True, labels=labels, loss_scale=1.0 / 4)
+    outs, _ = layer.forward({}, {}, [jnp.asarray(x)], ctx)
+    p = np.asarray(outs[0]).reshape(4, 10)
+    e = np.exp(x.reshape(4, 10) - x.reshape(4, 10).max(1, keepdims=True))
+    np.testing.assert_allclose(p, e / e.sum(1, keepdims=True), rtol=1e-5)
+    assert len(ctx.losses) == 1
+    expect_loss = -np.log(p[np.arange(4), [1, 3, 0, 7]]).sum() / 4
+    np.testing.assert_allclose(float(ctx.losses[0]), expect_loss, rtol=1e-5)
+
+
+def test_softmax_gradient_matches_reference_rule():
+    """Reference rule: d loss / d x = (p - onehot(y)) * scale
+    (softmax_layer-inl.hpp:23-31, loss_layer_base-inl.hpp:61-62)."""
+    x = rand4(4, 1, 1, 10)
+    y = np.array([[1.0], [3.0], [0.0], [7.0]], np.float32)
+    layer = create_layer("softmax")
+    scale = 1.0 / 4
+
+    def loss_fn(xj):
+        ctx = ForwardContext(train=True,
+                             labels=LabelInfo(fields={"label": jnp.asarray(y)}),
+                             loss_scale=scale)
+        layer.forward({}, {}, [xj], ctx)
+        return ctx.losses[0]
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 10)
+    e = np.exp(x.reshape(4, 10) - x.reshape(4, 10).max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    onehot = np.eye(10, dtype=np.float32)[y[:, 0].astype(int)]
+    np.testing.assert_allclose(g, (p - onehot) * scale, rtol=1e-4, atol=1e-6)
+
+
+def test_l2_loss_gradient():
+    x = rand4(4, 1, 1, 3)
+    y = rand4(4, 1, 1, 3, seed=9).reshape(4, 3)
+    layer = create_layer("l2_loss")
+
+    def loss_fn(xj):
+        ctx = ForwardContext(train=True,
+                             labels=LabelInfo(fields={"label": jnp.asarray(y)}),
+                             loss_scale=0.25)
+        layer.forward({}, {}, [xj], ctx)
+        return ctx.losses[0]
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 3)
+    np.testing.assert_allclose(g, (x.reshape(4, 3) - y) * 0.25,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_multi_logistic_gradient():
+    x = rand4(4, 1, 1, 3)
+    y = (rand4(4, 1, 1, 3, seed=5).reshape(4, 3) > 0).astype(np.float32)
+    layer = create_layer("multi_logistic")
+
+    def loss_fn(xj):
+        ctx = ForwardContext(train=True,
+                             labels=LabelInfo(fields={"label": jnp.asarray(y)}),
+                             loss_scale=1.0)
+        layer.forward({}, {}, [xj], ctx)
+        return ctx.losses[0]
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 3)
+    sig = 1 / (1 + np.exp(-x.reshape(4, 3)))
+    np.testing.assert_allclose(g, sig - y, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------------- pairtest
+def test_pairtest_identical_layers_agree():
+    x = rand4(2, 3, 6, 6)
+    layer = create_layer("pairtest-max_pooling-max_pooling")
+    layer.set_param("kernel_size", "2")
+    layer.set_param("stride", "2")
+    shapes = [tuple(x.shape)]
+    layer.infer_shapes(shapes)
+    params = layer.init_params(jax.random.PRNGKey(0), shapes)
+    ctx = ctx_eval()
+    outs, _ = layer.forward(params, {"master": {}, "slave": {}},
+                            [jnp.asarray(x)], ctx)
+    (key,) = [k for k in ctx.diagnostics if k.endswith("fwd_rel_err")]
+    assert float(ctx.diagnostics[key]) < 1e-5
+
+
+def test_pairtest_detects_divergence():
+    x = rand4(2, 3, 6, 6)
+    layer = create_layer("pairtest-max_pooling-avg_pooling")
+    layer.set_param("kernel_size", "2")
+    layer.set_param("stride", "2")
+    layer.infer_shapes([tuple(x.shape)])
+    ctx = ctx_eval()
+    outs, _ = layer.forward({}, {}, [jnp.asarray(x)], ctx)
+    (key,) = [k for k in ctx.diagnostics if k.endswith("fwd_rel_err")]
+    assert float(ctx.diagnostics[key]) > 1e-3

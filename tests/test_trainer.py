@@ -1,0 +1,282 @@
+"""End-to-end trainer tests: training convergence, checkpointing,
+data parallelism on the virtual 8-device mesh, grad accumulation."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+MLP_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:ac1] = relu
+layer[ac1->fc2] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 32
+dev = cpu
+eta = 0.5
+momentum = 0.9
+wd = 0.0
+metric = error
+"""
+
+
+def make_trainer(conf, extra=()):
+    t = NetTrainer()
+    for k, v in parse_config_string(conf):
+        t.set_param(k, v)
+    for k, v in extra:
+        t.set_param(k, v)
+    t.init_model()
+    return t
+
+
+def synth_batches(n_batches=20, bs=32, dim=8, seed=0):
+    """Linearly separable 2-class toy data."""
+    rnd = np.random.RandomState(seed)
+    w = rnd.randn(dim)
+    batches = []
+    for i in range(n_batches):
+        x = rnd.randn(bs, dim).astype(np.float32)
+        y = (x @ w > 0).astype(np.float32)
+        batches.append(DataBatch(
+            data=x.reshape(bs, 1, 1, dim),
+            label=y.reshape(bs, 1),
+            index=np.arange(i * bs, (i + 1) * bs, dtype=np.uint32)))
+    return batches
+
+
+def accuracy(trainer, batches):
+    correct = total = 0
+    for b in batches:
+        pred = trainer.predict(b)
+        correct += (pred == b.label[:, 0]).sum()
+        total += len(pred)
+    return correct / total
+
+
+def test_mlp_trains_to_high_accuracy():
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    batches = synth_batches()
+    t.start_round(1)
+    for _ in range(5):
+        for b in batches:
+            t.update(b)
+    assert accuracy(t, batches) > 0.95
+
+
+def test_train_metric_reporting():
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    batches = synth_batches(5)
+    t.start_round(1)
+    for b in batches:
+        t.update(b)
+    line = t.train_eval_line("train")
+    assert "train-error:" in line
+
+
+def test_evaluate_excludes_padding():
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    b = synth_batches(1)[0]
+    padded = DataBatch(data=b.data, label=b.label, index=b.index,
+                       num_batch_padd=30)
+    line = t.evaluate([padded], "test")
+    assert "test-error:" in line
+    # only 2 valid instances were scored
+    assert t.metric.evals[0].cnt_inst == 2
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    batches = synth_batches(5)
+    t.start_round(1)
+    for b in batches:
+        t.update(b)
+    path = str(tmp_path / "0001.model")
+    t.save_model(path)
+    t2 = NetTrainer()
+    for k, v in parse_config_string(MLP_CONF):
+        t2.set_param(k, v)
+    t2.set_param("silent", "1")
+    t2.load_model(path)
+    for b in batches:
+        np.testing.assert_allclose(t.predict_raw(b), t2.predict_raw(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert t2.epoch_counter == t.epoch_counter
+
+
+def test_finetune_copy_model(tmp_path):
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    path = str(tmp_path / "base.model")
+    t.save_model(path)
+    # new net with same fc1 but different fc2 width: only fc1 is copied
+    conf2 = MLP_CONF.replace("nhidden = 2", "nhidden = 4")
+    t2 = make_trainer(conf2, extra=[("silent", "1")])
+    t2.copy_model_from(path)
+    np.testing.assert_allclose(t2.get_weight("fc1", "wmat"),
+                               t.get_weight("fc1", "wmat"))
+    assert t2.get_weight("fc2", "wmat").shape[0] == 4
+
+
+def test_get_set_weight():
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    w = t.get_weight("fc1", "wmat")
+    t.set_weight(w * 0.0, "fc1", "wmat")
+    assert np.abs(t.get_weight("fc1", "wmat")).max() == 0.0
+
+
+def test_update_period_accumulation():
+    """update_period=2 with half lr*... should track update_period=1 with the
+    same total data: exact parity check of the accumulate path vs two
+    half-batches."""
+    t1 = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    t2 = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                       ("update_period", "2")])
+    # same init (deep copy: the jitted step donates its inputs)
+    import jax.numpy as jnp
+    for pkey in t1.params:
+        for tag in t1.params[pkey]:
+            t2.params[pkey][tag] = jnp.array(np.asarray(t1.params[pkey][tag]))
+    batches = synth_batches(4)
+    t1.start_round(1)
+    t2.start_round(1)
+    # t2 sees each batch twice via two updates of the same data → equivalent
+    # to t1 seeing it once (loss scaled by 1/(bs*2) per micro-batch)
+    for b in batches:
+        t1.update(b)
+        t2.update(b)
+        t2.update(b)
+    w1 = t1.get_weight("fc1", "wmat")
+    w2 = t2.get_weight("fc1", "wmat")
+    np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_device_data_parallel_matches_single():
+    import jax
+    assert len(jax.devices()) >= 8, "conftest should force 8 CPU devices"
+    t1 = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    t8 = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                       ("dev", "cpu:0-7")])
+    assert t8.mesh.devices.size == 8
+    for pkey in t1.params:
+        for tag in t1.params[pkey]:
+            t8.params[pkey][tag] = jax.device_put(
+                np.asarray(t1.params[pkey][tag]),
+                t8.param_shardings[pkey][tag])
+    batches = synth_batches(6)
+    t1.start_round(1)
+    t8.start_round(1)
+    for b in batches:
+        t1.update(b)
+        t8.update(b)
+    np.testing.assert_allclose(t1.get_weight("fc2", "wmat"),
+                               t8.get_weight("fc2", "wmat"),
+                               rtol=1e-4, atol=1e-5)
+    assert t8.check_weight_consistency() == 0.0
+
+
+def test_conv_net_end_to_end():
+    conf = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  stride = 2
+  nchannel = 8
+layer[1->2] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[2->3] = flatten
+layer[3->4] = fullc:fc1
+  nhidden = 4
+  init_sigma = 0.1
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,12,12
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric = error
+silent = 1
+"""
+    t = make_trainer(conf)
+    rnd = np.random.RandomState(3)
+    x = rnd.rand(8, 1, 12, 12).astype(np.float32)
+    y = rnd.randint(0, 4, (8, 1)).astype(np.float32)
+    b = DataBatch(data=x, label=y, index=np.arange(8, dtype=np.uint32))
+    t.start_round(1)
+    losses = []
+    for _ in range(30):
+        t.update(b)
+        losses.append(float(t._last_loss))
+    assert losses[-1] < losses[0] * 0.5, f"loss did not drop: {losses[:3]} -> {losses[-3:]}"
+
+
+def test_nag_and_adam_updaters():
+    for upd in ("nag", "adam"):
+        conf = MLP_CONF + f"\nupdater = {upd}\n"
+        extra = [("silent", "1")]
+        if upd == "adam":
+            extra.append(("eta", "0.01"))
+        t = make_trainer(conf, extra=extra)
+        batches = synth_batches(10)
+        t.start_round(1)
+        for _ in range(3):
+            for b in batches:
+                t.update(b)
+        assert accuracy(t, batches) > 0.9, f"{upd} failed to train"
+
+
+def test_lr_schedule_in_graph():
+    conf = MLP_CONF + """
+lr:schedule = factor
+lr:step = 2
+lr:factor = 0.5
+"""
+    t = make_trainer(conf, extra=[("silent", "1")])
+    b = synth_batches(1)[0]
+    t.start_round(1)
+    for _ in range(4):
+        t.update(b)
+    # just verify it runs and trains without recompiling per step
+    assert t.epoch_counter == 4
+
+
+def test_init_determinism():
+    """Same config + seed must give identical initial weights (regression:
+    param keys were hashed with Python's salted hash)."""
+    t1 = make_trainer(MLP_CONF, extra=[("silent", "1"), ("seed", "7")])
+    t2 = make_trainer(MLP_CONF, extra=[("silent", "1"), ("seed", "7")])
+    np.testing.assert_array_equal(t1.get_weight("fc1", "wmat"),
+                                  t2.get_weight("fc1", "wmat"))
+    t3 = make_trainer(MLP_CONF, extra=[("silent", "1"), ("seed", "8")])
+    assert np.abs(t3.get_weight("fc1", "wmat")
+                  - t1.get_weight("fc1", "wmat")).max() > 0
+
+
+def test_load_model_applies_config_overrides(tmp_path):
+    """Regression: hyperparameter overrides passed at load time must win
+    over the checkpointed config."""
+    t = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    path = str(tmp_path / "m.model")
+    t.save_model(path)
+    t2 = NetTrainer()
+    for k, v in parse_config_string(MLP_CONF):
+        t2.set_param(k, v)
+    t2.set_param("silent", "1")
+    t2.set_param("eta", "0.001")
+    t2.set_param("wmat:wd", "0.125")
+    t2.load_model(path)
+    h = t2.hypers[t2._resolve_param_key("fc1")]["wmat"]
+    assert h.base_lr == 0.001
+    assert h.wd == 0.125
+    assert t2.hypers[t2._resolve_param_key("fc1")]["bias"].wd != 0.125
