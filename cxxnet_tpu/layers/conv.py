@@ -72,17 +72,25 @@ class ConvolutionLayer(Layer):
 
 
 class _PoolingBase(Layer):
+    """Pooling base; supports ``pad``/``pad_y``/``pad_x`` (a superset of the
+    reference, whose pooling has no padding — needed for same-size inception
+    pool branches in GoogLeNet)."""
+
     def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
         assert len(in_shapes) == 1, "pooling: 1-1 connection only"
         p = self.param
         assert p.kernel_height > 0 and p.kernel_width > 0, \
             "pooling: must set kernel_size correctly"
         n, c, h, w = in_shapes[0]
-        assert p.kernel_height <= h and p.kernel_width <= w, \
+        assert p.kernel_height <= h + 2 * p.pad_y \
+            and p.kernel_width <= w + 2 * p.pad_x, \
             "pooling: kernel size exceeds input"
+        assert p.pad_y < p.kernel_height and p.pad_x < p.kernel_width, \
+            "pooling: pad must be smaller than kernel (a window fully inside " \
+            "the padding would produce -inf/0 garbage)"
         return [(n, c,
-                 N.pool_out_size(h, p.kernel_height, p.stride),
-                 N.pool_out_size(w, p.kernel_width, p.stride))]
+                 N.pool_out_size_padded(h, p.kernel_height, p.stride, p.pad_y),
+                 N.pool_out_size_padded(w, p.kernel_width, p.stride, p.pad_x))]
 
 
 class MaxPoolingLayer(_PoolingBase):
@@ -91,7 +99,7 @@ class MaxPoolingLayer(_PoolingBase):
     def forward(self, params, buffers, inputs, ctx):
         p = self.param
         return [N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
-                             p.stride)], buffers
+                             p.stride, p.pad_y, p.pad_x)], buffers
 
 
 class ReluMaxPoolingLayer(_PoolingBase):
@@ -102,7 +110,8 @@ class ReluMaxPoolingLayer(_PoolingBase):
     def forward(self, params, buffers, inputs, ctx):
         p = self.param
         x = jax.nn.relu(inputs[0])
-        return [N.max_pool2d(x, p.kernel_height, p.kernel_width, p.stride)], buffers
+        return [N.max_pool2d(x, p.kernel_height, p.kernel_width, p.stride,
+                             p.pad_y, p.pad_x)], buffers
 
 
 class SumPoolingLayer(_PoolingBase):
@@ -111,7 +120,7 @@ class SumPoolingLayer(_PoolingBase):
     def forward(self, params, buffers, inputs, ctx):
         p = self.param
         return [N.sum_pool2d(inputs[0], p.kernel_height, p.kernel_width,
-                             p.stride)], buffers
+                             p.stride, p.pad_y, p.pad_x)], buffers
 
 
 class AvgPoolingLayer(_PoolingBase):
@@ -120,7 +129,7 @@ class AvgPoolingLayer(_PoolingBase):
     def forward(self, params, buffers, inputs, ctx):
         p = self.param
         return [N.avg_pool2d(inputs[0], p.kernel_height, p.kernel_width,
-                             p.stride)], buffers
+                             p.stride, p.pad_y, p.pad_x)], buffers
 
 
 class InsanityPoolingLayer(_PoolingBase):
@@ -137,6 +146,12 @@ class InsanityPoolingLayer(_PoolingBase):
     """
 
     type_names = ("insanity_max_pooling",)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert self.param.pad_y == 0 and self.param.pad_x == 0, \
+            "insanity_max_pooling does not support padding (neither does the "\
+            "reference's, insanity_pooling_layer-inl.hpp)"
+        return super().infer_shapes(in_shapes)
 
     def forward(self, params, buffers, inputs, ctx):
         p = self.param

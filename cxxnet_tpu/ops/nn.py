@@ -58,18 +58,28 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
     )
 
 
-def _pool_padding(h: int, w: int, kh: int, kw: int, stride: int
-                  ) -> Tuple[Tuple[int, int], Tuple[int, int], int, int]:
-    oh = pool_out_size(h, kh, stride)
-    ow = pool_out_size(w, kw, stride)
-    pad_h = max(0, (oh - 1) * stride + kh - h)
-    pad_w = max(0, (ow - 1) * stride + kw - w)
-    return (0, pad_h), (0, pad_w), oh, ow
+def pool_out_size_padded(in_size: int, ksize: int, stride: int,
+                         pad: int) -> int:
+    """Pool output size with symmetric leading padding (a superset of the
+    reference, which has no pool padding; needed for same-size inception
+    pool branches)."""
+    return pool_out_size(in_size + 2 * pad, ksize, stride)
 
 
-def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
-               ) -> jnp.ndarray:
-    pad_h, pad_w, _, _ = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x, stride)
+def _pool_padding(h: int, w: int, kh: int, kw: int, stride: int,
+                  pad_y: int, pad_x: int
+                  ) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    oh = pool_out_size_padded(h, kh, stride, pad_y)
+    ow = pool_out_size_padded(w, kw, stride, pad_x)
+    tail_h = max(0, (oh - 1) * stride + kh - h - 2 * pad_y)
+    tail_w = max(0, (ow - 1) * stride + kw - w - 2 * pad_x)
+    return (pad_y, pad_y + tail_h), (pad_x, pad_x + tail_w)
+
+
+def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    pad_h, pad_w = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x,
+                                 stride, pad_y, pad_x)
     return lax.reduce_window(
         x, -jnp.inf, lax.max,
         window_dimensions=(1, 1, ksize_y, ksize_x),
@@ -77,9 +87,10 @@ def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
         padding=((0, 0), (0, 0), pad_h, pad_w))
 
 
-def sum_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
-               ) -> jnp.ndarray:
-    pad_h, pad_w, _, _ = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x, stride)
+def sum_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    pad_h, pad_w = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x,
+                                 stride, pad_y, pad_x)
     return lax.reduce_window(
         x, 0.0, lax.add,
         window_dimensions=(1, 1, ksize_y, ksize_x),
@@ -87,11 +98,12 @@ def sum_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
         padding=((0, 0), (0, 0), pad_h, pad_w))
 
 
-def avg_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int
-               ) -> jnp.ndarray:
+def avg_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
     """Average pooling; divides by the *full* kernel size even for clipped
-    tail windows, matching the reference (pooling_layer-inl.hpp:47-53)."""
-    s = sum_pool2d(x, ksize_y, ksize_x, stride)
+    tail windows / padding, matching the reference
+    (pooling_layer-inl.hpp:47-53)."""
+    s = sum_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     return s * jnp.array(1.0 / (ksize_y * ksize_x), x.dtype)
 
 

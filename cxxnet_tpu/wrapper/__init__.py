@@ -1,0 +1,3 @@
+from .api import DataIter, Net, train
+
+__all__ = ["DataIter", "Net", "train"]

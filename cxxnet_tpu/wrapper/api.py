@@ -1,0 +1,182 @@
+"""numpy-facing Python API: Net / DataIter / train.
+
+Reference: ``wrapper/cxxnet.py`` (Python-2 ctypes wrapper over the C ABI,
+``wrapper/cxxnet_wrapper.h``).  Same surface, modern Python: a ``Net`` is
+configured by a config string + set_param calls, updates on numpy batches or
+a DataIter, and exposes predict/extract/evaluate/get_weight/set_weight.  The
+C ABI itself lives in ``native/capi`` (see native/README.md) for C/C++
+embedders; Python users get this module directly — no ctypes round trip
+through a C shim just to come back into Python.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..io.data import DataBatch
+from ..io.factory import create_iterator, init_iterator
+from ..nnet.trainer import NetTrainer
+from ..utils.config import parse_config_string
+
+
+class DataIter:
+    """Iterator built from a config string (CXNIOCreateFromConfig parity:
+    the same ``iter = ...`` sections the CLI uses)."""
+
+    def __init__(self, cfg: str):
+        pairs = parse_config_string(cfg)
+        self._it = create_iterator(pairs)
+        init_iterator(self._it, [])
+        self.head = True
+        self.tail = False
+        self._batch: Optional[DataBatch] = None
+
+    def before_first(self) -> None:
+        self._it.before_first()
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        if self.head:
+            self._it.before_first()
+        self._batch = self._it.next()
+        self.head = False
+        self.tail = self._batch is None
+        return not self.tail
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError(
+                "iterator at head state, call next() to get to a valid state")
+        if self.tail:
+            raise RuntimeError("iterator reached the end")
+
+    @property
+    def value(self) -> DataBatch:
+        self.check_valid()
+        return self._batch
+
+    def get_data(self) -> np.ndarray:
+        self.check_valid()
+        return self._batch.data
+
+    def get_label(self) -> np.ndarray:
+        self.check_valid()
+        return self._batch.label
+
+
+def _as_batch(data: np.ndarray, label: Optional[np.ndarray]) -> DataBatch:
+    if data.ndim != 4:
+        raise ValueError(
+            "need a 4-d tensor (batch, channel, height, width)")
+    if label is None:
+        label = np.zeros((data.shape[0], 1), np.float32)
+    else:
+        label = np.asarray(label, np.float32)
+        if label.ndim == 1:
+            label = label.reshape(-1, 1)
+        if label.ndim != 2 or label.shape[0] != data.shape[0]:
+            raise ValueError("label must be (batch,) or (batch, width)")
+    return DataBatch(data=np.asarray(data, np.float32), label=label,
+                     index=np.arange(data.shape[0], dtype=np.uint32))
+
+
+class Net:
+    """Neural net object (CXNNetCreate parity)."""
+
+    def __init__(self, dev: str = "tpu", cfg: str = ""):
+        self._trainer = NetTrainer()
+        self._trainer.set_param("dev", dev)
+        for k, v in parse_config_string(cfg):
+            self._trainer.set_param(k, v)
+
+    def set_param(self, name, value) -> None:
+        self._trainer.set_param(str(name), str(value))
+
+    def init_model(self) -> None:
+        self._trainer.init_model()
+
+    def load_model(self, fname: str) -> None:
+        self._trainer.load_model(fname)
+
+    def save_model(self, fname: str) -> None:
+        self._trainer.save_model(fname)
+
+    def copy_model_from(self, fname: str) -> None:
+        self._trainer.copy_model_from(fname)
+
+    def start_round(self, round_counter: int) -> None:
+        self._trainer.start_round(round_counter)
+
+    def update(self, data, label: Optional[np.ndarray] = None) -> None:
+        """Update on a DataIter's current batch or a numpy (data, label)."""
+        if isinstance(data, DataIter):
+            data.check_valid()
+            self._trainer.update(data.value)
+        elif isinstance(data, np.ndarray):
+            if label is None:
+                raise ValueError("Net.update: need label to update")
+            self._trainer.update(_as_batch(data, label))
+        else:
+            raise TypeError(f"update does not support {type(data)}")
+
+    def predict(self, data) -> np.ndarray:
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return self._trainer.predict(data.value)
+        return self._trainer.predict(_as_batch(np.asarray(data), None))
+
+    def extract(self, data, node_name: str) -> np.ndarray:
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return self._trainer.extract_feature(data.value, node_name)
+        return self._trainer.extract_feature(
+            _as_batch(np.asarray(data), None), node_name)
+
+    def evaluate(self, data: "DataIter", name: str) -> str:
+        return self._trainer.evaluate(iter(data._it), name)
+
+    def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:
+        if tag not in ("wmat", "bias"):
+            raise ValueError("tag must be bias or wmat")
+        try:
+            return self._trainer.get_weight(layer_name, tag)
+        except KeyError:
+            return None
+
+    def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
+        if tag not in ("wmat", "bias"):
+            raise ValueError("tag must be bias or wmat")
+        self._trainer.set_weight(np.asarray(weight, np.float32),
+                                 layer_name, tag)
+
+
+def train(cfg: str, data, num_round: int, param, eval_data=None,
+          label: Optional[np.ndarray] = None, dev: str = "tpu") -> Net:
+    """One-call train loop (wrapper/cxxnet.py train parity).
+
+    ``data`` is a DataIter, or a numpy array with ``label=``.
+    """
+    net = Net(dev=dev, cfg=cfg)
+    items = param.items() if isinstance(param, dict) else param
+    for k, v in items:
+        net.set_param(k, v)
+    net.init_model()
+    for r in range(num_round):
+        net.start_round(r)
+        if isinstance(data, DataIter):
+            data.before_first()
+            scounter = 0
+            while data.next():
+                net.update(data)
+                scounter += 1
+                if scounter % 100 == 0:
+                    print(f"[{r}] {scounter} batch passed")
+        else:
+            net.update(data=data, label=label)
+        if eval_data is not None:
+            print(net.evaluate(eval_data, "eval"), file=sys.stderr)
+    return net

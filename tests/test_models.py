@@ -1,0 +1,159 @@
+"""Model zoo + wrapper API tests: configs parse, shapes check out, tiny
+variants train."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.models import alexnet, googlenet, lenet, mlp
+from cxxnet_tpu.nnet.net import Network
+from cxxnet_tpu.nnet.netconfig import NetConfig
+from cxxnet_tpu.utils.config import parse_config_string
+
+
+def build(conf_text, batch=2):
+    nc = NetConfig()
+    nc.configure(parse_config_string(conf_text))
+    return Network(nc, batch)
+
+
+def test_mlp_builder():
+    net = build(mlp(num_class=10, input_dim=784, hidden=[100]))
+    assert net.node_shapes[net.final_node] == (2, 1, 1, 10)
+
+
+def test_lenet_builder():
+    net = build(lenet())
+    assert net.node_shapes[net.final_node] == (2, 1, 1, 10)
+
+
+def test_alexnet_builder_shapes():
+    net = build(alexnet())
+    # canonical AlexNet intermediate shapes
+    shapes = [net.node_shapes[c.nindex_out[0]] for c in net.connections]
+    assert (2, 96, 55, 55) in shapes     # conv1
+    assert (2, 256, 27, 27) in shapes    # conv2
+    assert (2, 256, 6, 6) in shapes      # pool5
+    assert net.node_shapes[net.final_node] == (2, 1, 1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for g in net.init_params(__import__("jax").random.PRNGKey(0)).values()
+                   for p in g.values())
+    assert 55_000_000 < n_params < 70_000_000  # ~61M
+
+
+def test_googlenet_builder_shapes():
+    net = build(googlenet())
+    shapes = {net.node_shapes[c.nindex_out[0]] for c in net.connections}
+    assert (2, 256, 28, 28) in shapes    # inception 3a out
+    assert (2, 480, 28, 28) in shapes    # inception 3b out
+    assert (2, 832, 7, 7) in shapes      # inception 5a in
+    assert (2, 1024, 1, 1) in shapes     # global avg pool
+    assert net.node_shapes[net.final_node] == (2, 1, 1, 1000)
+    import jax
+    n_params = sum(int(np.prod(p.shape))
+                   for g in net.init_params(jax.random.PRNGKey(0)).values()
+                   for p in g.values())
+    assert 5_000_000 < n_params < 8_000_000  # ~7M (v1 single head)
+
+
+def test_tiny_googlenet_trains():
+    """Scaled-down inception net end-to-end: split/ch_concat/padded-pool
+    multi-branch graph trains under jit."""
+    from cxxnet_tpu.models.zoo import _inception
+    lines = [
+        "netconfig=start",
+        "layer[0->c1] = conv:conv1",
+        "  kernel_size = 3", "  stride = 2", "  nchannel = 8",
+        "layer[+0] = relu",
+    ]
+    top = _inception(lines, "ia", "c1", 4, 4, 8, 2, 4, 4)
+    lines += [
+        f"layer[{top}->gp] = avg_pooling",
+        "  kernel_size = 3", "  stride = 2",
+        "layer[gp->fl] = flatten",
+        "layer[fl->fc] = fullc:fc",
+        "  nhidden = 4",
+        "layer[fc->fc] = softmax",
+        "netconfig=end",
+        "input_shape = 3,16,16",
+    ]
+    conf = "\n".join(lines) + "\nbatch_size = 8\ndev = cpu\neta = 0.3\nmetric = error\nsilent = 1\n"
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.io.data import DataBatch
+    t = NetTrainer()
+    for k, v in parse_config_string(conf):
+        t.set_param(k, v)
+    t.init_model()
+    rnd = np.random.RandomState(0)
+    b = DataBatch(data=rnd.rand(8, 3, 16, 16).astype(np.float32),
+                  label=rnd.randint(0, 4, (8, 1)).astype(np.float32),
+                  index=np.arange(8, dtype=np.uint32))
+    t.start_round(1)
+    losses = []
+    for _ in range(60):
+        t.update(b)
+        losses.append(float(t._last_loss))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_pooling_pad_shapes():
+    """pad on pooling keeps inception pool branch same-size."""
+    conf = """
+netconfig=start
+layer[0->1] = max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+netconfig=end
+input_shape = 3,14,14
+"""
+    net = build(conf)
+    assert net.node_shapes[1] == (2, 3, 14, 14)
+
+
+def test_wrapper_api_numpy_train():
+    from cxxnet_tpu.wrapper import Net, train
+    conf = mlp(num_class=2, input_dim=8, hidden=[16])
+    rnd = np.random.RandomState(0)
+    w = rnd.randn(8)
+    x = rnd.randn(64, 8).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    net = train(conf, x.reshape(64, 1, 1, 8), 30,
+                {"batch_size": 64, "eta": 0.5, "momentum": 0.9,
+                 "silent": 1, "metric": "error"},
+                label=y, dev="cpu")
+    pred = net.predict(x.reshape(64, 1, 1, 8))
+    assert (pred == y).mean() > 0.9
+    # weight access API
+    assert net.get_weight("fc1", "wmat").shape == (16, 8)
+    assert net.get_weight("nope", "wmat") is None
+    with pytest.raises(ValueError):
+        net.get_weight("fc1", "junk")
+
+
+def test_wrapper_dataiter(tmp_path):
+    import gzip
+    import struct
+    from cxxnet_tpu.wrapper import DataIter
+    rnd = np.random.RandomState(0)
+    imgs = (rnd.rand(20, 4, 4) * 255).astype(np.uint8)
+    labs = rnd.randint(0, 3, 20).astype(np.uint8)
+    with gzip.open(tmp_path / "img.gz", "wb") as f:
+        f.write(struct.pack(">iiii", 2051, 20, 4, 4))
+        f.write(imgs.tobytes())
+    with gzip.open(tmp_path / "lab.gz", "wb") as f:
+        f.write(struct.pack(">ii", 2049, 20))
+        f.write(labs.tobytes())
+    it = DataIter(f"""
+iter = mnist
+path_img = "{tmp_path}/img.gz"
+path_label = "{tmp_path}/lab.gz"
+batch_size = 10
+silent = 1
+""")
+    assert it.next()
+    assert it.get_data().shape == (10, 1, 1, 16)
+    assert it.get_label().shape == (10, 1)
+    n = 1
+    while it.next():
+        n += 1
+    assert n == 2
