@@ -185,7 +185,10 @@ class LearnTask:
         if self.continue_training == 0 and self.name_model_in == "NULL":
             self._save_model()
         if self.itr_train is None:
-            return
+            raise RuntimeError(
+                "task=train but the config has no 'data = train' iterator "
+                "section; add one (see example/MNIST/MNIST.conf) or use the "
+                "wrapper API for in-memory data")
         if self.test_io:
             print("start I/O test")
         cc = self.max_round

@@ -62,8 +62,14 @@ def pool_out_size_padded(in_size: int, ksize: int, stride: int,
                          pad: int) -> int:
     """Pool output size with symmetric leading padding (a superset of the
     reference, which has no pool padding; needed for same-size inception
-    pool branches)."""
-    return pool_out_size(in_size + 2 * pad, ksize, stride)
+    pool branches).
+
+    Capped so the last window's start ``(o-1)*stride - pad`` still touches a
+    real input element — otherwise tail windows lying entirely inside the
+    padding would emit -inf (max) / 0 (sum) garbage.
+    """
+    o = pool_out_size(in_size + 2 * pad, ksize, stride)
+    return min(o, (in_size - 1 + pad) // stride + 1)
 
 
 def _pool_padding(h: int, w: int, kh: int, kw: int, stride: int,

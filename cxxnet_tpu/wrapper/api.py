@@ -12,7 +12,7 @@ through a C shim just to come back into Python.
 from __future__ import annotations
 
 import sys
-from typing import Iterable, List, Optional, Tuple, Union
+from typing import Optional
 
 import numpy as np
 
@@ -40,8 +40,6 @@ class DataIter:
         self.tail = False
 
     def next(self) -> bool:
-        if self.head:
-            self._it.before_first()
         self._batch = self._it.next()
         self.head = False
         self.tail = self._batch is None
@@ -137,6 +135,9 @@ class Net:
             _as_batch(np.asarray(data), None), node_name)
 
     def evaluate(self, data: "DataIter", name: str) -> str:
+        if not isinstance(data, DataIter):
+            raise TypeError(
+                f"evaluate needs a DataIter, got {type(data).__name__}")
         return self._trainer.evaluate(iter(data._it), name)
 
     def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:

@@ -196,6 +196,21 @@ def test_pooling(mode, layer, hw, k, s):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_padded_pooling_no_all_padding_windows():
+    """Tail windows lying entirely inside the padding must be dropped:
+    stride > input extent with pad used to emit -inf rows."""
+    x = np.ones((1, 1, 3, 3), np.float32)
+    (y,), _ = run_layer("max_pooling", x,
+                        {"kernel_size": 2, "stride": 4, "pad": 1})
+    assert y.shape == (1, 1, 1, 1)
+    assert np.isfinite(y).all() and y[0, 0, 0, 0] == 1.0
+    # stride <= kernel variant: kernel=3, stride=2, pad=2 on h=2
+    x = np.ones((1, 1, 2, 2), np.float32)
+    (y,), _ = run_layer("max_pooling", x,
+                        {"kernel_size": 3, "stride": 2, "pad": 2})
+    assert np.isfinite(y).all()
+
+
 def test_relu_max_pooling():
     x = rand4(2, 3, 6, 6)
     (y,), _ = run_layer("relu_max_pooling", x, {"kernel_size": 2, "stride": 2})
