@@ -239,6 +239,10 @@ class ThreadBufferIterator(IIterator):
 
     def init(self):
         self.base.init()
+        # prime the first producer so next() works straight after init(),
+        # like every other iterator (the reference's ThreadBuffer also starts
+        # its thread at Init, thread_buffer.h:30-38)
+        self.before_first()
 
     def _producer(self, gen: int, q: "queue.Queue"):
         while True:

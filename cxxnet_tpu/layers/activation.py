@@ -64,6 +64,17 @@ class SoftplusLayer(_UnaryLayer):
         return jax.nn.softplus(x)
 
 
+class GeluLayer(_UnaryLayer):
+    """Gaussian error linear unit (tanh approximation) — no reference
+    counterpart (the reference predates gelu); standard for the sequence
+    model family."""
+
+    type_names = ("gelu",)
+
+    def _fn(self, x, ctx):
+        return jax.nn.gelu(x)
+
+
 class XeluLayer(_UnaryLayer):
     """Leaky relu with divisor b: x>0 ? x : x/b (op.h:51-61; default b=5)."""
 

@@ -80,6 +80,9 @@ class ForwardContext:
     losses: List[jnp.ndarray] = dataclasses.field(default_factory=list)
     # diagnostics appended by pairtest layers etc.
     diagnostics: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    # device mesh for layers that shard explicitly (ring attention over a
+    # "seq" axis); None for single-device runs
+    mesh: Optional[Any] = None
     _rng_count: int = 0
 
     def next_rng(self) -> jax.Array:

@@ -10,8 +10,9 @@ from __future__ import annotations
 
 from typing import Dict, Type
 
-from .activation import (BiasLayer, InsanityLayer, PReluLayer, ReluLayer,
-                         SigmoidLayer, SoftplusLayer, TanhLayer, XeluLayer)
+from .activation import (BiasLayer, GeluLayer, InsanityLayer, PReluLayer,
+                         ReluLayer, SigmoidLayer, SoftplusLayer, TanhLayer,
+                         XeluLayer)
 from .base import Layer
 from .conv import (AvgPoolingLayer, ConvolutionLayer, InsanityPoolingLayer,
                    LRNLayer, MaxPoolingLayer, ReluMaxPoolingLayer,
@@ -20,8 +21,10 @@ from .fullc import FixConnectLayer, FullConnectLayer
 from .loss import L2LossLayer, MultiLogisticLayer, SoftmaxLayer
 from .norm import BatchNormLayer, DropoutLayer
 from .pairtest import PairTestLayer
-from .shape_ops import (ChConcatLayer, ConcatLayer, FlattenLayer, MaxoutLayer,
-                        SplitLayer)
+from .sequence import (AttentionLayer, EmbeddingLayer, LayerNormLayer,
+                       SeqFullcLayer, SoftmaxSeqLayer)
+from .shape_ops import (ChConcatLayer, ConcatLayer, EltSumLayer, FlattenLayer,
+                        MaxoutLayer, SplitLayer)
 
 _REGISTRY: Dict[str, Type[Layer]] = {}
 
@@ -37,7 +40,9 @@ for _cls in (ReluLayer, SigmoidLayer, TanhLayer, SoftplusLayer, XeluLayer,
              ReluMaxPoolingLayer, SumPoolingLayer, AvgPoolingLayer,
              InsanityPoolingLayer, LRNLayer, BatchNormLayer, DropoutLayer,
              FlattenLayer, SplitLayer, ConcatLayer, ChConcatLayer,
-             MaxoutLayer, SoftmaxLayer, L2LossLayer, MultiLogisticLayer):
+             MaxoutLayer, EltSumLayer, SoftmaxLayer, L2LossLayer,
+             MultiLogisticLayer, GeluLayer, EmbeddingLayer, LayerNormLayer,
+             SeqFullcLayer, AttentionLayer, SoftmaxSeqLayer):
     register(_cls)
 
 

@@ -1,0 +1,275 @@
+"""Sequence-model layers: embedding, layernorm, per-position linear, gelu,
+multi-head attention (dense or ring/sequence-parallel), and the LM softmax
+loss.
+
+The reference framework predates attention entirely (SURVEY.md §5.7: data is
+fixed (N,C,H,W) images), so these layers have no file:line counterparts —
+they exist because long-context is first-class in this framework.  They fit
+the same config-driven ILayer system: a sequence node is a 4-D
+(batch, 1, seq, dim) tensor, token-id inputs are (batch, 1, 1, seq), so every
+existing mechanism (netconfig graph syntax, visitors/tags, checkpointing,
+pairtest) applies unchanged.
+
+Sequence parallelism: when the trainer's mesh has a ``seq`` axis, attention
+runs as ring attention over ICI (``parallel/ring.py``) and the per-position
+layers constrain their activations to stay seq-sharded; XLA then never
+gathers the full sequence on one device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import ring
+from .base import ForwardContext, Layer, Params, Shape4
+from .loss import LossLayerBase
+
+
+def _seq_mesh(ctx: ForwardContext):
+    """The mesh if sequence parallelism is active, else None."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+        return mesh
+    return None
+
+
+def seq_constraint(x: jnp.ndarray, ctx: ForwardContext) -> jnp.ndarray:
+    """Pin a (b, 1, s, d) activation to the seq-sharded layout."""
+    mesh = _seq_mesh(ctx)
+    if mesh is None or x.shape[2] % mesh.shape["seq"] != 0:
+        return x
+    dp = "data" if "data" in mesh.axis_names else None
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, P(dp, None, "seq", None)))
+
+
+class EmbeddingLayer(Layer):
+    """Token embedding: (b,1,1,s) float ids -> (b,1,s,d).
+
+    Params: "wmat" (vocab, d); with ``pos_embed = 1`` also "wpos" (s, d)
+    learned positional embeddings (sequence length is static under jit, so
+    the table is sized at shape inference).
+    """
+
+    type_names = ("embedding",)
+
+    def __init__(self):
+        super().__init__()
+        self.vocab_size = 0
+        self.pos_embed = 0
+
+    def set_param(self, name, val):
+        if name == "vocab_size":
+            self.vocab_size = int(val)
+        elif name == "pos_embed":
+            self.pos_embed = int(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "embedding: 1-1 connection only"
+        n, c, h, s = in_shapes[0]
+        assert c == 1 and h == 1, "embedding: input must be (b,1,1,seq) ids"
+        assert self.vocab_size > 0, "embedding: must set vocab_size"
+        assert self.param.num_hidden > 0, "embedding: must set nhidden"
+        return [(n, 1, s, self.param.num_hidden)]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        d = self.param.num_hidden
+        s = in_shapes[0][3]
+        kw, kp = jax.random.split(key)
+        sigma = self.param.init_sigma
+        params = {"wmat": sigma * jax.random.normal(
+            kw, (self.vocab_size, d), dtype)}
+        if self.pos_embed:
+            params["wpos"] = sigma * jax.random.normal(kp, (s, d), dtype)
+        return params
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        ids = inputs[0].reshape(inputs[0].shape[0], -1).astype(jnp.int32)
+        out = jnp.take(params["wmat"], ids, axis=0)  # (b, s, d)
+        if "wpos" in params:
+            out = out + params["wpos"][None, :, :].astype(out.dtype)
+        out = out[:, None, :, :]
+        return [seq_constraint(out, ctx)], buffers
+
+
+class LayerNormLayer(Layer):
+    """Layer normalization over the feature (last) axis of (b,1,s,d).
+
+    Learned slope/bias exposed under the standard "wmat"/"bias" tags (the
+    batchnorm layer does the same) so ``wmat:lr`` scoping and the weight
+    visitors work.
+    """
+
+    type_names = ("layernorm",)
+
+    def __init__(self):
+        super().__init__()
+        self.eps = 1e-5
+
+    def set_param(self, name, val):
+        if name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "layernorm: 1-1 connection only"
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        d = in_shapes[0][3]
+        return {"wmat": jnp.ones((d,), dtype),
+                "bias": jnp.zeros((d,), dtype)}
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(axis=-1, keepdims=True)
+        var = jnp.square(x32 - mean).mean(axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["wmat"].astype(jnp.float32) \
+            + params["bias"].astype(jnp.float32)
+        return [y.astype(x.dtype)], buffers
+
+
+class SeqFullcLayer(Layer):
+    """Per-position linear on the last axis: (b,1,s,d) -> (b,1,s,nhidden).
+
+    Unlike ``fullc`` (which flattens the node to (b, c*h*w) — correct for
+    image heads, wrong for sequences), this is position-wise.  Weight "wmat"
+    (nhidden, d), bias "bias" (nhidden,) — same tags/layout as fullc.
+    """
+
+    type_names = ("seq_fullc",)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "seq_fullc: 1-1 connection only"
+        n, c, s, d = in_shapes[0]
+        assert c == 1, "seq_fullc: input must be (b,1,s,d)"
+        assert self.param.num_hidden > 0, "seq_fullc: must set nhidden"
+        return [(n, 1, s, self.param.num_hidden)]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        d = in_shapes[0][3]
+        nh = self.param.num_hidden
+        kw, kb = jax.random.split(key)
+        params = {"wmat": self.param.rand_init_weight(kw, (nh, d), d, nh, dtype)}
+        if not self.param.no_bias:
+            params["bias"] = jnp.full((nh,), self.param.init_bias, dtype)
+        return params
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]
+        w = params["wmat"].astype(x.dtype)
+        out = jnp.einsum("bcsd,nd->bcsn", x, w)
+        if "bias" in params:
+            out = out + params["bias"].astype(x.dtype)
+        return [seq_constraint(out, ctx)], buffers
+
+
+class AttentionLayer(Layer):
+    """Multi-head self-attention on (b,1,s,d).
+
+    Params: "wqkv" (3d, d), "wout" (d, d), biases "bqkv"/"bout" unless
+    ``no_bias``.  Config: ``nhead`` (required), ``causal = 0|1``.
+
+    When the trainer mesh has a ``seq`` axis the score computation runs as
+    ring attention (K/V rotating over ICI, online softmax — see
+    ``parallel/ring.py``); otherwise dense attention.  Head count must
+    divide d; when a ``model`` axis exists and divides nhead, heads are
+    additionally sharded over it inside the ring (Ulysses-style hybrid).
+    """
+
+    type_names = ("attention",)
+
+    def __init__(self):
+        super().__init__()
+        self.nhead = 0
+        self.causal = 0
+
+    def set_param(self, name, val):
+        if name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = int(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "attention: 1-1 connection only"
+        n, c, s, d = in_shapes[0]
+        assert c == 1, "attention: input must be (b,1,s,d)"
+        assert self.nhead > 0, "attention: must set nhead"
+        assert d % self.nhead == 0, "attention: nhead must divide dim"
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        d = in_shapes[0][3]
+        kq, ko = jax.random.split(key)
+        params = {
+            "wqkv": self.param.rand_init_weight(kq, (3 * d, d), d, 3 * d, dtype),
+            "wout": self.param.rand_init_weight(ko, (d, d), d, d, dtype),
+        }
+        if not self.param.no_bias:
+            params["bqkv"] = jnp.zeros((3 * d,), dtype)
+            params["bout"] = jnp.zeros((d,), dtype)
+        return params
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]
+        b, _, s, d = x.shape
+        h = self.nhead
+        hd = d // h
+        qkv = jnp.einsum("bcsd,nd->bcsn", x, params["wqkv"].astype(x.dtype))
+        if "bqkv" in params:
+            qkv = qkv + params["bqkv"].astype(x.dtype)
+        qkv = qkv.reshape(b, s, 3, h, hd).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # (b, h, s, hd)
+        mesh = _seq_mesh(ctx)
+        if mesh is not None and s % mesh.shape["seq"] == 0:
+            att = ring.sharded_attention(q, k, v, mesh,
+                                         causal=bool(self.causal))
+        else:
+            att = ring.dense_attention(q, k, v, causal=bool(self.causal))
+        att = att.transpose(0, 2, 1, 3).reshape(b, 1, s, d)
+        out = jnp.einsum("bcsd,nd->bcsn", att, params["wout"].astype(x.dtype))
+        if "bout" in params:
+            out = out + params["bout"].astype(x.dtype)
+        return [seq_constraint(out, ctx)], buffers
+
+
+class SoftmaxSeqLayer(LossLayerBase):
+    """Per-position softmax + cross-entropy LM loss (self-loop).
+
+    Input (b,1,s,V); label field is (b, s) token ids (declare
+    ``label_vec[0,s) = label`` so the label vector carries one id per
+    position).  Loss is the mean per-token cross-entropy, summed over the
+    batch with the same ``grad_scale/(batch·update_period)`` scaling as the
+    image losses (inherited from LossLayerBase).  forward is overridden
+    because the (b, s, V) structure must survive — the base class flattens
+    to (b, s*V).
+    """
+
+    type_names = ("softmax_seq",)
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x = inputs[0]  # (b, 1, s, V)
+        out = jax.nn.softmax(x, axis=-1)
+        if ctx.labels is not None and ctx.train:
+            y = ctx.labels.get(self.target).astype(jnp.int32)  # (b, s)
+            logp = jax.nn.log_softmax(x[:, 0].astype(jnp.float32), axis=-1)
+            tok = jnp.take_along_axis(logp, y[:, :, None], axis=2)[:, :, 0]
+            per_inst = -tok.mean(axis=1)  # mean per-token nats, per instance
+            ctx.losses.append(per_inst.sum() * (self.grad_scale * ctx.loss_scale))
+        return [out], buffers

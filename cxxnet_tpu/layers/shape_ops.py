@@ -103,3 +103,27 @@ class MaxoutLayer(Layer):
         n, c, h, w = x.shape
         k = self.param.num_group
         return [x.reshape(n, c // k, k, h, w).max(axis=2)], buffers
+
+
+class EltSumLayer(Layer):
+    """N -> 1 elementwise sum of same-shape nodes (residual connections).
+
+    No reference counterpart (the reference predates residual nets); the
+    graph syntax already supports it: ``layer[a,b->c] = eltsum``.
+    """
+
+    type_names = ("eltsum",)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) >= 2, "eltsum: needs at least 2 inputs"
+        for s in in_shapes[1:]:
+            assert s == in_shapes[0], \
+                f"eltsum: input shapes differ: {s} vs {in_shapes[0]}"
+        return [in_shapes[0]]
+
+    def forward(self, params, buffers, inputs, ctx):
+        assert len(inputs) >= 2, "eltsum: needs at least 2 inputs"
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out], buffers

@@ -217,7 +217,8 @@ class NetTrainer:
                   for name, a, b in self._label_fields} if label_vec is not None else {}
         ctx = ForwardContext(train=train, rng=rng,
                              labels=LabelInfo(fields=fields) if fields else None,
-                             epoch=epoch, loss_scale=self.loss_scale)
+                             epoch=epoch, loss_scale=self.loss_scale,
+                             mesh=self.mesh if self.mesh.size > 1 else None)
         inputs = {0: data}
         for i, e in enumerate(extras):
             inputs[1 + i] = e

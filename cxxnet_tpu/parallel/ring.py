@@ -1,0 +1,113 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has no sequence axis at all (SURVEY.md §5.7 — data is fixed
+4-D images), but this framework treats long-context as first-class: the
+``seq`` mesh axis shards the sequence dimension across devices, and
+attention runs as a ring — each device holds its local Q block resident
+while K/V blocks rotate around the ring via ``ppermute`` over ICI, with
+flash-style online-softmax accumulation so no device ever materialises the
+full (s, s) score matrix.  Communication overlaps with the block matmuls
+(XLA pipelines the ppermute DMA with the next block's compute).
+
+``ring_attention`` must run *inside* ``shard_map`` (it uses
+``lax.axis_index`` / ``lax.ppermute``); ``dense_attention`` is the
+single-device oracle used by the layer when no seq axis is configured and
+by the differential tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/where() NaN-free
+
+
+def _block_scores(q: jnp.ndarray, k: jnp.ndarray, scale: float,
+                  q_off, k_off, causal: bool) -> jnp.ndarray:
+    """(b,h,sq,d) x (b,h,sk,d) -> (b,h,sq,sk) float32 scores with causal
+    masking in *global* positions (offsets account for ring rotation)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[2])
+        kpos = k_off + jnp.arange(k.shape[2])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    return s
+
+
+def _online_update(s, v, acc, m, l):
+    """One flash-attention accumulation step in float32."""
+    new_m = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - new_m)
+    corr = jnp.exp(m - new_m)
+    l = l * corr + p.sum(axis=-1, keepdims=True)
+    acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return acc, new_m, l
+
+
+def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Plain softmax attention, (b, h, s, d) -> (b, h, s, d)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = _block_scores(q, k, scale, 0, 0, causal)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(p.dtype)).astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Blockwise ring attention over mesh axis ``axis_name``.
+
+    Args are the *local shards* (b, h, s_local, d); the sequence axis is
+    sharded over ``axis_name``.  K/V rotate around the ring; every device
+    accumulates its Q block's output with online softmax.  Exact (not
+    approximate) — matches ``dense_attention`` on the gathered arrays.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    q32 = q.astype(jnp.float32)
+    q_off = my * s_local
+    acc = jnp.zeros(q.shape[:3] + (v.shape[3],), jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # static unrolled ring: n is a mesh constant, so XLA sees a straight-line
+    # pipeline of (matmul, ppermute) pairs it can overlap
+    for i in range(n):
+        src = (my - i) % n  # the shard whose K/V block we currently hold
+        s = _block_scores(q32, k.astype(jnp.float32), scale,
+                          q_off, src * k.shape[2], causal)
+        acc, m, l = _online_update(s, v, acc, m, l)
+        if i + 1 < n:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    return (acc / l).astype(q.dtype)
+
+
+def sharded_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, causal: bool = False,
+                      seq_axis: str = "seq") -> jnp.ndarray:
+    """shard_map wrapper: global (b, h, s, d) arrays in, attention computed
+    as a ring over ``seq_axis`` (batch stays sharded over "data" and heads
+    over "model" when those axes exist)."""
+    dp = "data" if "data" in mesh.axis_names else None
+    hp = ("model" if "model" in mesh.axis_names
+          and q.shape[1] % mesh.shape["model"] == 0 else None)
+    spec = P(dp, hp, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -13,35 +13,7 @@ import pytest
 from cxxnet_tpu.layers.base import ForwardContext, LabelInfo
 from cxxnet_tpu.layers.registry import create_layer
 from cxxnet_tpu.ops import nn as N
-
-
-def ctx_eval():
-    return ForwardContext(train=False)
-
-
-def ctx_train(seed=0):
-    return ForwardContext(train=True, rng=jax.random.PRNGKey(seed))
-
-
-def run_layer(type_name, x, cfg=None, train=False, in_shapes=None, seed=0):
-    layer = create_layer(type_name)
-    for k, v in (cfg or {}).items():
-        layer.set_param(k, str(v))
-    xs = x if isinstance(x, list) else [x]
-    shapes = in_shapes or [tuple(a.shape) for a in xs]
-    out_shapes = layer.infer_shapes(shapes)
-    params = layer.init_params(jax.random.PRNGKey(42), shapes)
-    buffers = layer.init_buffers(shapes)
-    ctx = ctx_train(seed) if train else ctx_eval()
-    outs, _ = layer.forward(params, buffers,
-                            [jnp.asarray(a) for a in xs], ctx)
-    for o, s in zip(outs, out_shapes):
-        assert tuple(o.shape) == s, f"{type_name}: shape {o.shape} != {s}"
-    return [np.asarray(o) for o in outs], params
-
-
-def rand4(*shape, seed=0):
-    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+from helpers import ctx_eval, ctx_train, rand4, run_layer
 
 
 # ---------------------------------------------------------------- activations

@@ -157,3 +157,20 @@ silent = 1
     while it.next():
         n += 1
     assert n == 2
+    # threadbuffer-wrapped iterator must also be ready right after init
+    # (regression: next() on a fresh DataIter used to assert)
+    it2 = DataIter(f"""
+iter = mnist
+path_img = "{tmp_path}/img.gz"
+path_label = "{tmp_path}/lab.gz"
+batch_size = 10
+silent = 1
+iter = threadbuffer
+""")
+    assert it2.next()
+    assert it2.get_data().shape == (10, 1, 1, 16)
+    it2.before_first()
+    n = 0
+    while it2.next():
+        n += 1
+    assert n == 2

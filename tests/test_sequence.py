@@ -1,0 +1,179 @@
+"""Sequence stack tests: layer oracles, ring-vs-dense attention equivalence
+on the 8-device CPU mesh, and end-to-end transformer LM training with
+sequence parallelism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cxxnet_tpu.layers.base import ForwardContext
+from cxxnet_tpu.layers.registry import create_layer
+from cxxnet_tpu.parallel import ring
+from helpers import rand4 as rand, run_layer
+
+
+# ------------------------------------------------------------------ layers
+def test_layernorm_oracle():
+    x = rand(2, 1, 5, 16)
+    (y,), _ = run_layer("layernorm", x)
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, (x - mu) / sd, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_and_positions():
+    ids = np.array([[[[1, 3, 0]]], [[[2, 2, 1]]]], np.float32)  # (2,1,1,3)
+    (y,), params = run_layer("embedding", ids,
+                             {"vocab_size": 5, "nhidden": 8, "pos_embed": 1})
+    w, wp = np.asarray(params["wmat"]), np.asarray(params["wpos"])
+    expect = w[ids[:, 0, 0].astype(int)] + wp[None, :, :]
+    np.testing.assert_allclose(y[:, 0], expect, rtol=1e-5)
+
+
+def test_seq_fullc_is_positionwise():
+    x = rand(2, 1, 4, 8)
+    (y,), params = run_layer("seq_fullc", x, {"nhidden": 6})
+    w, b = np.asarray(params["wmat"]), np.asarray(params["bias"])
+    np.testing.assert_allclose(y, x @ w.T + b, rtol=1e-4, atol=1e-5)
+
+
+def test_eltsum():
+    a, b = rand(2, 3, 4, 5), rand(2, 3, 4, 5, seed=1)
+    (y,), _ = run_layer("eltsum", [a, b])
+    np.testing.assert_allclose(y, a + b, rtol=1e-6)
+
+
+def test_attention_dense_oracle():
+    """Dense attention vs a straightforward numpy softmax-attention."""
+    b, s, d, h = 2, 6, 16, 4
+    x = rand(b, 1, s, d)
+    (y,), params = run_layer("attention", x, {"nhead": h, "no_bias": 1})
+    wqkv, wout = np.asarray(params["wqkv"]), np.asarray(params["wout"])
+    qkv = x[:, 0] @ wqkv.T  # (b, s, 3d)
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def split_heads(t):
+        return t.reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
+    q, k, v = map(split_heads, (q, k, v))
+    sc = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d // h)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    att = (p @ v).transpose(0, 2, 1, 3).reshape(b, 1, s, d)
+    np.testing.assert_allclose(y, att @ wout.T, rtol=1e-3, atol=1e-4)
+
+
+def test_attention_causal_masks_future():
+    """With causal=1, output at position t must not depend on tokens > t."""
+    b, s, d, h = 1, 5, 8, 2
+    x = rand(b, 1, s, d)
+    layer = create_layer("attention")
+    for k, v in {"nhead": h, "causal": 1, "no_bias": 1}.items():
+        layer.set_param(k, str(v))
+    layer.infer_shapes([x.shape])
+    params = layer.init_params(jax.random.PRNGKey(3), [x.shape])
+    ctx = ForwardContext(train=False)
+    (y1,), _ = layer.forward(params, {}, [jnp.asarray(x)], ctx)
+    x2 = x.copy()
+    x2[:, :, -1, :] += 100.0  # perturb the last token only
+    (y2,), _ = layer.forward(params, {}, [jnp.asarray(x2)], ctx)
+    np.testing.assert_allclose(np.asarray(y1)[:, :, :-1],
+                               np.asarray(y2)[:, :, :-1], rtol=1e-5)
+    assert not np.allclose(np.asarray(y1)[:, :, -1], np.asarray(y2)[:, :, -1])
+
+
+# ----------------------------------------------------------- ring attention
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("mesh_axes", [(("seq", 8),), (("data", 2), ("seq", 4))])
+def test_ring_equals_dense(causal, mesh_axes):
+    devs = jax.devices()
+    n = int(np.prod([s for _, s in mesh_axes]))
+    mesh = Mesh(np.array(devs[:n]).reshape([s for _, s in mesh_axes]),
+                [a for a, _ in mesh_axes])
+    b, h, s, d = 2, 2, 16, 8
+    q, k, v = rand(b, h, s, d), rand(b, h, s, d, seed=1), rand(b, h, s, d, seed=2)
+    dense = ring.dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), causal=causal)
+    ringed = ring.sharded_attention(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_under_jit_grad():
+    """Ring attention must be differentiable inside jit (training path)."""
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:4]).reshape(4), ["seq"])
+    b, h, s, d = 1, 2, 8, 4
+    q, k, v = (jnp.asarray(rand(b, h, s, d, seed=i)) for i in range(3))
+
+    @jax.jit
+    def loss(q, k, v):
+        return ring.sharded_attention(q, k, v, mesh, causal=True).sum()
+
+    g = jax.grad(loss)(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+    # matches dense-attention gradient
+    g_dense = jax.grad(
+        lambda q, k, v: ring.dense_attention(q, k, v, causal=True).sum()
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_dense),
+                               rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------------------- end to end
+def _train_lm(mesh_cfg, steps=80, batch=8):
+    """Tiny copy-task LM: predict the previous token (trivially learnable
+    with a causal model)."""
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import transformer
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    vocab, seq = 8, 16
+    conf = transformer(vocab=vocab, seq=seq, dim=16, nlayer=1, nhead=2)
+    t = NetTrainer()
+    for k, v in parse_config_string(conf):
+        t.set_param(k, v)
+    t.set_param("batch_size", str(batch))
+    t.set_param("dev", mesh_cfg["dev"])
+    if mesh_cfg.get("mesh"):
+        t.set_param("mesh", mesh_cfg["mesh"])
+    t.set_param("updater", "adam")
+    t.set_param("eta", "0.01")
+    t.set_param("silent", "1")
+    t.init_model()
+    rnd = np.random.RandomState(0)
+    t.start_round(1)
+    losses = []
+    for i in range(steps):
+        toks = rnd.randint(1, vocab, (batch, seq)).astype(np.float32)
+        label = np.concatenate([np.zeros((batch, 1), np.float32),
+                                toks[:, :-1]], axis=1)  # predict prev token
+        b = DataBatch(data=toks.reshape(batch, 1, 1, seq), label=label,
+                      index=np.arange(batch, dtype=np.uint32))
+        t.update(b)
+        losses.append(float(np.asarray(t._last_loss)))
+    return losses, t
+
+
+def test_transformer_trains_single_device():
+    losses, _ = _train_lm({"dev": "cpu"})
+    assert losses[-1] < losses[0] * 0.5, losses[::20]
+
+
+def test_transformer_trains_sequence_parallel():
+    """Same LM over a data:2,seq:4 mesh: ring attention + dp; loss must
+    drop and replicas stay consistent."""
+    losses, t = _train_lm({"dev": "cpu:0-7", "mesh": "data:2,seq:4"})
+    assert losses[-1] < losses[0] * 0.5, losses[::20]
+    assert t.check_weight_consistency() == 0.0
+
+
+def test_transformer_seq_parallel_matches_single():
+    """First-step loss must be identical (same seed) with and without the
+    seq mesh — sequence parallelism is an implementation detail, not a
+    model change."""
+    l1, _ = _train_lm({"dev": "cpu"}, steps=3)
+    l2, _ = _train_lm({"dev": "cpu:0-7", "mesh": "data:2,seq:4"}, steps=3)
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
