@@ -18,14 +18,15 @@ gathers the full sequence on one device.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import warnings
+from typing import List
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import ring
-from .base import ForwardContext, Layer, Params, Shape4
+from .base import ForwardContext, Layer, Shape4
 from .loss import LossLayerBase
 
 
@@ -240,6 +241,12 @@ class AttentionLayer(Layer):
             att = ring.sharded_attention(q, k, v, mesh,
                                          causal=bool(self.causal))
         else:
+            if mesh is not None:
+                warnings.warn(
+                    f"attention: seq length {s} is not divisible by the "
+                    f"seq mesh axis ({mesh.shape['seq']}); falling back to "
+                    "dense attention, which gathers the full sequence on "
+                    "one device", stacklevel=2)
             att = ring.dense_attention(q, k, v, causal=bool(self.causal))
         att = att.transpose(0, 2, 1, 3).reshape(b, 1, s, d)
         out = jnp.einsum("bcsd,nd->bcsn", att, params["wout"].astype(x.dtype))

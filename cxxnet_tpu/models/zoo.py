@@ -1,0 +1,266 @@
+"""Config-text builders for the model zoo.
+
+Each function returns the text of a ``netconfig=start/end`` block plus the
+``input_shape`` (and, for sequence models, ``label_vec``) lines.  Global
+training keys (batch_size, eta, dev, ...) are the caller's business — same
+split as the reference's config files, where the net block and the training
+section are independent (``src/nnet/nnet_config.h:255-287``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def mlp(num_class: int = 10, input_dim: int = 784,
+        hidden: Sequence[int] = (100,)) -> str:
+    """Fully-connected softmax classifier (the MNIST.conf MLP shape).
+
+    Hidden layers are named ``fc1..fcN``, the classifier head ``fcN+1``.
+    """
+    lines = ["netconfig=start"]
+    for i, nh in enumerate(hidden):
+        lines += [f"layer[+1] = fullc:fc{i + 1}", f"  nhidden = {nh}",
+                  "layer[+0] = relu"]
+    lines += [f"layer[+1] = fullc:fc{len(hidden) + 1}",
+              f"  nhidden = {num_class}",
+              "layer[+0] = softmax",
+              "netconfig=end",
+              f"input_shape = 1,1,{input_dim}"]
+    return "\n".join(lines) + "\n"
+
+
+def lenet(num_class: int = 10) -> str:
+    """LeNet-style MNIST convnet (the MNIST_CONV.conf shape): two
+    conv+pool stages and a 500-wide hidden layer."""
+    return f"""
+netconfig=start
+layer[0->1] = conv:conv1
+  kernel_size = 5
+  nchannel = 20
+layer[1->2] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[2->3] = relu
+layer[3->4] = conv:conv2
+  kernel_size = 5
+  nchannel = 50
+layer[4->5] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[5->6] = relu
+layer[6->7] = flatten
+layer[7->8] = fullc:fc1
+  nhidden = 500
+layer[8->9] = relu
+layer[9->10] = fullc:fc2
+  nhidden = {num_class}
+layer[10->10] = softmax
+netconfig=end
+input_shape = 1,28,28
+"""
+
+
+def alexnet(num_class: int = 1000) -> str:
+    """AlexNet (the ImageNet.conf:26-95 architecture): 5 conv stages with
+    grouped conv2/4/5, LRN after conv1/2, three 4096/4096/num_class fullc
+    layers with dropout."""
+    return f"""
+netconfig=start
+layer[0->1] = conv:conv1
+  kernel_size = 11
+  stride = 4
+  nchannel = 96
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[3->4] = lrn
+  local_size = 5
+  alpha = 0.001
+  beta = 0.75
+  knorm = 1
+layer[4->5] = conv:conv2
+  ngroup = 2
+  kernel_size = 5
+  pad = 2
+  nchannel = 256
+layer[5->6] = relu
+layer[6->7] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[7->8] = lrn
+  local_size = 5
+  alpha = 0.001
+  beta = 0.75
+  knorm = 1
+layer[8->9] = conv:conv3
+  kernel_size = 3
+  pad = 1
+  nchannel = 384
+layer[9->10] = relu
+layer[10->11] = conv:conv4
+  ngroup = 2
+  kernel_size = 3
+  pad = 1
+  nchannel = 384
+layer[11->12] = relu
+layer[12->13] = conv:conv5
+  ngroup = 2
+  kernel_size = 3
+  pad = 1
+  nchannel = 256
+layer[13->14] = relu
+layer[14->15] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[15->16] = flatten
+layer[16->17] = fullc:fc6
+  nhidden = 4096
+layer[17->18] = relu
+layer[18->18] = dropout
+  threshold = 0.5
+layer[18->19] = fullc:fc7
+  nhidden = 4096
+layer[19->20] = relu
+layer[20->20] = dropout
+  threshold = 0.5
+layer[20->21] = fullc:fc8
+  nhidden = {num_class}
+layer[21->21] = softmax
+netconfig=end
+input_shape = 3,227,227
+"""
+
+
+def _conv_relu(lines: List[str], bottom: str, top: str, name: str,
+               nchannel: int, ksize: int, pad: int = 0,
+               stride: int = 1) -> str:
+    lines += [f"layer[{bottom}->{top}] = conv:{name}",
+              f"  kernel_size = {ksize}",
+              f"  nchannel = {nchannel}",
+              "  random_type = xavier"]
+    if stride != 1:
+        lines.append(f"  stride = {stride}")
+    if pad:
+        lines.append(f"  pad = {pad}")
+    lines.append("layer[+0] = relu")
+    return top
+
+
+def _inception(lines: List[str], name: str, bottom: str,
+               n1x1: int, n3x3red: int, n3x3: int,
+               n5x5red: int, n5x5: int, proj: int) -> str:
+    """Append a GoogLeNet inception module; returns the top node name.
+
+    4-way split -> {1x1, 1x1->3x3, 1x1->5x5, pool->1x1} -> ch_concat (the
+    concat layer's 4-input cap, concat_layer-inl.hpp, is exactly the branch
+    count).  The pool branch relies on padded pooling — a superset of the
+    reference's pooling, needed to keep the branch same-size.
+    """
+    sp = [f"{name}_sp{i}" for i in range(4)]
+    lines.append(f"layer[{bottom}->{','.join(sp)}] = split")
+    b0 = _conv_relu(lines, sp[0], f"{name}_b0", f"{name}_1x1", n1x1, 1)
+    _conv_relu(lines, sp[1], f"{name}_r3", f"{name}_3x3r", n3x3red, 1)
+    b1 = _conv_relu(lines, f"{name}_r3", f"{name}_b1", f"{name}_3x3",
+                    n3x3, 3, pad=1)
+    _conv_relu(lines, sp[2], f"{name}_r5", f"{name}_5x5r", n5x5red, 1)
+    b2 = _conv_relu(lines, f"{name}_r5", f"{name}_b2", f"{name}_5x5",
+                    n5x5, 5, pad=2)
+    lines += [f"layer[{sp[3]}->{name}_p] = max_pooling",
+              "  kernel_size = 3", "  stride = 1", "  pad = 1"]
+    b3 = _conv_relu(lines, f"{name}_p", f"{name}_b3", f"{name}_proj", proj, 1)
+    lines.append(f"layer[{b0},{b1},{b2},{b3}->{name}] = ch_concat")
+    return name
+
+
+def googlenet(num_class: int = 1000) -> str:
+    """GoogLeNet v1, single head (no aux classifiers): 9 inception modules.
+
+    No reference config exists (SURVEY.md §6: config-to-write, not
+    config-to-port); channel plan is the canonical v1 table.
+    """
+    lines = ["netconfig=start"]
+    _conv_relu(lines, "0", "c1", "conv1", 64, 7, pad=3, stride=2)
+    lines += ["layer[c1->p1] = max_pooling",
+              "  kernel_size = 3", "  stride = 2",
+              "layer[p1->n1] = lrn",
+              "  local_size = 5", "  alpha = 0.0001", "  beta = 0.75",
+              "  knorm = 1"]
+    _conv_relu(lines, "n1", "c2r", "conv2r", 64, 1)
+    _conv_relu(lines, "c2r", "c2", "conv2", 192, 3, pad=1)
+    lines += ["layer[c2->n2] = lrn",
+              "  local_size = 5", "  alpha = 0.0001", "  beta = 0.75",
+              "  knorm = 1",
+              "layer[n2->p2] = max_pooling",
+              "  kernel_size = 3", "  stride = 2"]
+    top = _inception(lines, "i3a", "p2", 64, 96, 128, 16, 32, 32)
+    top = _inception(lines, "i3b", top, 128, 128, 192, 32, 96, 64)
+    lines += [f"layer[{top}->p3] = max_pooling",
+              "  kernel_size = 3", "  stride = 2"]
+    top = _inception(lines, "i4a", "p3", 192, 96, 208, 16, 48, 64)
+    top = _inception(lines, "i4b", top, 160, 112, 224, 24, 64, 64)
+    top = _inception(lines, "i4c", top, 128, 128, 256, 24, 64, 64)
+    top = _inception(lines, "i4d", top, 112, 144, 288, 32, 64, 64)
+    top = _inception(lines, "i4e", top, 256, 160, 320, 32, 128, 128)
+    lines += [f"layer[{top}->p4] = max_pooling",
+              "  kernel_size = 3", "  stride = 2"]
+    top = _inception(lines, "i5a", "p4", 256, 160, 320, 32, 128, 128)
+    top = _inception(lines, "i5b", top, 384, 192, 384, 48, 128, 128)
+    lines += [f"layer[{top}->gp] = avg_pooling",
+              "  kernel_size = 7", "  stride = 1",
+              "layer[gp->gp] = dropout",
+              "  threshold = 0.4",
+              "layer[gp->fl] = flatten",
+              "layer[fl->fc] = fullc:fc",
+              f"  nhidden = {num_class}",
+              "layer[fc->fc] = softmax",
+              "netconfig=end",
+              "input_shape = 3,224,224"]
+    return "\n".join(lines) + "\n"
+
+
+def transformer(vocab: int, seq: int, dim: int, nlayer: int,
+                nhead: int, causal: int = 1, ffn_mult: int = 4) -> str:
+    """Pre-norm decoder-only transformer LM.
+
+    Input node is (b,1,1,seq) token ids, labels are per-position targets via
+    ``label_vec[0,seq)``.  No reference counterpart (SURVEY.md §5.7) — this
+    is the long-context model family; attention runs as ring attention when
+    the trainer mesh has a ``seq`` axis.
+    """
+    lines = ["netconfig=start",
+             "layer[0->x0] = embedding:embed",
+             f"  vocab_size = {vocab}",
+             f"  nhidden = {dim}",
+             "  pos_embed = 1",
+             "  init_sigma = 0.02"]
+    top = "x0"
+    for i in range(nlayer):
+        a, m, nxt = f"b{i}a", f"b{i}m", f"x{i + 1}"
+        lines += [
+            f"layer[{top}->{a}_r,{a}_in] = split",
+            f"layer[{a}_in->{a}_n] = layernorm:l{i}_ln1",
+            f"layer[{a}_n->{a}_o] = attention:l{i}_att",
+            f"  nhead = {nhead}",
+            f"  causal = {causal}",
+            f"layer[{a}_r,{a}_o->{m}] = eltsum",
+            f"layer[{m}->{m}_r,{m}_in] = split",
+            f"layer[{m}_in->{m}_n] = layernorm:l{i}_ln2",
+            f"layer[{m}_n->{m}_h] = seq_fullc:l{i}_ffn1",
+            f"  nhidden = {ffn_mult * dim}",
+            "layer[+0] = gelu",
+            f"layer[{m}_h->{m}_o] = seq_fullc:l{i}_ffn2",
+            f"  nhidden = {dim}",
+            f"layer[{m}_r,{m}_o->{nxt}] = eltsum",
+        ]
+        top = nxt
+    lines += [f"layer[{top}->fin] = layernorm:final_ln",
+              "layer[fin->logits] = seq_fullc:head",
+              f"  nhidden = {vocab}",
+              "  no_bias = 1",
+              "layer[+0] = softmax_seq",
+              "netconfig=end",
+              f"input_shape = 1,1,{seq}",
+              f"label_vec[0,{seq}) = label"]
+    return "\n".join(lines) + "\n"

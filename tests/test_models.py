@@ -63,6 +63,7 @@ def test_tiny_googlenet_trains():
         "netconfig=start",
         "layer[0->c1] = conv:conv1",
         "  kernel_size = 3", "  stride = 2", "  nchannel = 8",
+        "  random_type = xavier",
         "layer[+0] = relu",
     ]
     top = _inception(lines, "ia", "c1", 4, 4, 8, 2, 4, 4)
@@ -76,7 +77,7 @@ def test_tiny_googlenet_trains():
         "netconfig=end",
         "input_shape = 3,16,16",
     ]
-    conf = "\n".join(lines) + "\nbatch_size = 8\ndev = cpu\neta = 0.3\nmetric = error\nsilent = 1\n"
+    conf = "\n".join(lines) + "\nbatch_size = 8\ndev = cpu\neta = 0.1\nmetric = error\nsilent = 1\n"
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.io.data import DataBatch
     t = NetTrainer()
