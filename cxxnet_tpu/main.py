@@ -40,7 +40,9 @@ class LearnTask:
         self.extract_node_name = ""
         self.name_pred = "pred.txt"
         self.output_format = 1
-        self.eval_train = 0
+        # default 1, reference nnet_impl-inl.hpp:22; gates both metric
+        # accumulation (NetTrainer) and the train metric line below
+        self.eval_train = 1
         self.device = "tpu"
         self.cfg: List[Tuple[str, str]] = []
         self.net: Optional[NetTrainer] = None
@@ -213,7 +215,10 @@ class LearnTask:
                           flush=True)
             if self.test_io == 0:
                 line = f"[{self.start_counter}]"
-                if self.eval_train or not self.itr_evals:
+                # only print the train metric when the trainer actually
+                # accumulated it (eval_train also gates accumulation in
+                # NetTrainer.update — a 0 here would print all-zero metrics)
+                if self.eval_train:
                     line += self.net.train_eval_line("train")
                 for it, name in zip(self.itr_evals, self.eval_names):
                     line += self.net.evaluate(it, name)

@@ -64,6 +64,11 @@ class NetTrainer:
         self.shard_opt_state = 0
         self.silent = 0
         self.print_step = 100
+        # eval_train=0 skips per-step host materialization of eval nodes for
+        # the train metric — the D2H copy is a per-step sync (expensive over
+        # a tunneled link; reference copies scores out every Update,
+        # nnet_impl-inl.hpp:174-180, because its D2H was on-node PCIe)
+        self.eval_train = 1
         # metric bindings: (metric_name, label_field, node_name or "")
         self._metric_req: List[Tuple[str, str, str]] = []
         self.metric = MetricSet()
@@ -95,6 +100,8 @@ class NetTrainer:
             self.shard_opt_state = int(val)
         elif name == "silent":
             self.silent = int(val)
+        elif name == "eval_train":
+            self.eval_train = int(val)
         elif name == "print_step":
             self.print_step = int(val)
         elif name.startswith("metric"):
@@ -172,6 +179,7 @@ class NetTrainer:
         self._label_fields = self.netcfg.label_fields()
         self._make_shardings()
         self._train_step = self._build_train_step()
+        self._multi_step_cache: Dict[int, Any] = {}
         self._eval_step_cache = {}
         self._grad_acc = None
         self.sample_counter = 0
@@ -225,35 +233,42 @@ class NetTrainer:
         nodes, new_buffers = self.net.forward(params, buffers, inputs, ctx)
         return nodes, new_buffers, ctx
 
+    def _loss_and_grads(self, params, buffers, data, label_vec, extras,
+                        epoch, rng, eval_ids):
+        def loss_fn(p):
+            nodes, new_buffers, ctx = self._forward(
+                p, buffers, data, label_vec, extras,
+                train=True, rng=rng, epoch=epoch)
+            assert ctx.losses, "network has no loss layer; cannot train"
+            total = sum(ctx.losses[1:], ctx.losses[0])
+            outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                    for nid in eval_ids}
+            return total, (new_buffers, outs, ctx.diagnostics)
+        return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+    def _apply_update(self, params, opt_state, grads, epoch):
+        new_p, new_s = {}, {}
+        for pkey, group in params.items():
+            new_p[pkey], new_s[pkey] = {}, {}
+            for tag, p in group.items():
+                q, s = self.updater.apply(
+                    p, grads[pkey][tag], opt_state[pkey][tag],
+                    self.hypers[pkey][tag], epoch)
+                new_p[pkey][tag] = q
+                new_s[pkey][tag] = s
+        return new_p, new_s
+
     def _build_train_step(self):
         accumulate = self.update_period > 1
-        updater = self.updater
-        hypers = self.hypers
         eval_ids = tuple(dict.fromkeys(self.eval_node_ids))
 
         def loss_and_grads(params, buffers, data, label_vec, extras, epoch, rng):
-            def loss_fn(p):
-                nodes, new_buffers, ctx = self._forward(
-                    p, buffers, data, label_vec, extras,
-                    train=True, rng=rng, epoch=epoch)
-                assert ctx.losses, "network has no loss layer; cannot train"
-                total = sum(ctx.losses[1:], ctx.losses[0])
-                outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
-                        for nid in eval_ids}
-                return total, (new_buffers, outs, ctx.diagnostics)
-            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+            return self._loss_and_grads(params, buffers, data, label_vec,
+                                        extras, epoch, rng, eval_ids)
 
         def apply_update(operand, epoch):
             params, opt_state, grads = operand
-            new_p, new_s = {}, {}
-            for pkey, group in params.items():
-                new_p[pkey], new_s[pkey] = {}, {}
-                for tag, p in group.items():
-                    q, s = updater.apply(
-                        p, grads[pkey][tag], opt_state[pkey][tag],
-                        hypers[pkey][tag], epoch)
-                    new_p[pkey][tag] = q
-                    new_s[pkey][tag] = s
+            new_p, new_s = self._apply_update(params, opt_state, grads, epoch)
             zeroed = jax.tree.map(jnp.zeros_like, grads)
             return new_p, new_s, zeroed
 
@@ -298,6 +313,76 @@ class NetTrainer:
         return jax.jit(step, in_shardings=shardings_in,
                        out_shardings=shardings_out,
                        donate_argnums=(0, 1, 2))
+
+    def _build_multi_step(self, nsteps: int):
+        """One jitted ``lax.scan`` over ``nsteps`` sequential updates.
+
+        The parameter/optimizer trajectory is identical to ``nsteps`` calls
+        of :meth:`update` (period 1), including the per-step PRNG keys
+        (``fold_in(rng_base, sample_counter)``, matching update()'s
+        increment-then-fold).  What it does NOT do: accumulate the train
+        metric or populate ``_last_outs``/``_last_diags`` — it is the
+        throughput path; metrics need per-step host copies.  A single
+        dispatch amortizes host->device launch latency across the scan: the
+        reference hides per-batch launch cost with its ThreadBuffer prefetch
+        thread (iter_batch_proc-inl.hpp:136-224); on TPU the idiomatic
+        equivalent is keeping the loop on device.
+        """
+        if nsteps in self._multi_step_cache:
+            return self._multi_step_cache[nsteps]
+        assert self.update_period == 1, \
+            "update_many requires update_period=1 (use update() for " \
+            "gradient accumulation)"
+
+        def body(carry, xs):
+            params, opt_state, buffers, epoch, rng_base = carry
+            data, label_vec = xs
+            # epoch here == sample_counter-1 of the equivalent update() call,
+            # which folds AFTER incrementing — hence epoch + 1
+            rng = jax.random.fold_in(rng_base, epoch + 1)
+            (loss, (new_buffers, _, _)), grads = self._loss_and_grads(
+                params, buffers, data, label_vec, (), epoch, rng, ())
+            new_p, new_s = self._apply_update(params, opt_state, grads, epoch)
+            return (new_p, new_s, new_buffers, epoch + 1, rng_base), loss
+
+        def run(params, opt_state, buffers, epoch, rng_base, datas, labels):
+            carry = (params, opt_state, buffers, epoch, rng_base)
+            carry, losses = jax.lax.scan(body, carry, (datas, labels))
+            params, opt_state, buffers, epoch, _ = carry
+            return params, opt_state, buffers, losses
+
+        stacked = NamedSharding(self.mesh, P(None, *self.batch_shard.spec))
+        fn = jax.jit(
+            run,
+            in_shardings=(self.param_shardings, self.opt_shardings,
+                          self.buffer_shardings, self.repl, self.repl,
+                          stacked, stacked),
+            out_shardings=(self.param_shardings, self.opt_shardings,
+                           self.buffer_shardings, self.repl),
+            donate_argnums=(0, 1, 2))
+        self._multi_step_cache[nsteps] = fn
+        return fn
+
+    def update_many(self, datas, labels) -> "jnp.ndarray":
+        """Run ``k`` sequential training steps in one device dispatch.
+
+        ``datas``: (k, batch, c, h, w); ``labels``: (k, batch, label_width).
+        Returns the (k,) per-step losses (lazy device array).  Train metrics
+        and ``_last_outs`` are NOT accumulated (see _build_multi_step).
+        """
+        datas = jnp.asarray(datas)
+        labels = jnp.asarray(labels, jnp.float32)
+        k = datas.shape[0]
+        fn = self._build_multi_step(k)
+        (self.params, self.opt_state, self.buffers, losses) = fn(
+            self.params, self.opt_state, self.buffers,
+            jnp.int32(self.epoch_counter), self._rng_base, datas, labels)
+        self.sample_counter += k
+        self.epoch_counter += k
+        self._last_loss = losses[-1]
+        self._last_outs = None
+        self._last_diags = None
+        return losses
 
     def _get_eval_step(self, node_ids: Tuple[int, ...]):
         if node_ids in self._eval_step_cache:
@@ -351,7 +436,7 @@ class NetTrainer:
         self._last_loss = loss
         self._last_outs = outs
         self._last_diags = diags
-        if self.train_metric.evals:
+        if self.eval_train and self.train_metric.evals:
             preds = [np.asarray(outs[nid]) for nid in self.eval_node_ids]
             labels = {name: batch.label[:, a:b]
                       for name, a, b in self._label_fields}

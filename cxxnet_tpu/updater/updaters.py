@@ -138,16 +138,34 @@ class UpdaterHyper:
 
 
 class Updater:
-    """Pure per-tensor optimizer: state pytree in, state pytree out."""
+    """Pure per-tensor optimizer: state pytree in, state pytree out.
+
+    Update arithmetic always runs in float32 and the new parameter is cast
+    back to the parameter's own dtype; optimizer state is float32 regardless
+    of model dtype.  This keeps ``dtype = bfloat16`` training stable (bf16
+    momentum would lose ~2 decimal digits per step) AND keeps the step's
+    pytree dtypes fixed — params must not silently promote to f32, which
+    would both recompile the jitted step and turn every matmul into an f32
+    one (half MXU throughput)."""
 
     name = ""
 
     def init_state(self, p: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         return {}
 
+    def _state32(self, p: jnp.ndarray) -> jnp.ndarray:
+        return jnp.zeros(p.shape, jnp.float32)
+
     def apply(self, p: jnp.ndarray, g: jnp.ndarray,
               state: Dict[str, jnp.ndarray], hyper: UpdaterHyper,
               epoch) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        q, new_state = self._apply32(
+            p.astype(jnp.float32), g.astype(jnp.float32), state, hyper, epoch)
+        return q.astype(p.dtype), new_state
+
+    def _apply32(self, p: jnp.ndarray, g: jnp.ndarray,
+                 state: Dict[str, jnp.ndarray], hyper: UpdaterHyper,
+                 epoch) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         raise NotImplementedError
 
 
@@ -158,9 +176,9 @@ class SGDUpdater(Updater):
     name = "sgd"
 
     def init_state(self, p):
-        return {"m": jnp.zeros_like(p)}
+        return {"m": self._state32(p)}
 
-    def apply(self, p, g, state, hyper, epoch):
+    def _apply32(self, p, g, state, hyper, epoch):
         lr, mom = hyper.schedule(epoch)
         g = hyper.clip(g)
         m = mom * state["m"] - lr * (g + hyper.wd * p)
@@ -174,9 +192,9 @@ class NAGUpdater(Updater):
     name = "nag"
 
     def init_state(self, p):
-        return {"m": jnp.zeros_like(p)}
+        return {"m": self._state32(p)}
 
-    def apply(self, p, g, state, hyper, epoch):
+    def _apply32(self, p, g, state, hyper, epoch):
         lr, mom = hyper.schedule(epoch)
         g = hyper.clip(g)
         m_old = state["m"]
@@ -193,9 +211,9 @@ class AdamUpdater(Updater):
     name = "adam"
 
     def init_state(self, p):
-        return {"m1": jnp.zeros_like(p), "m2": jnp.zeros_like(p)}
+        return {"m1": self._state32(p), "m2": self._state32(p)}
 
-    def apply(self, p, g, state, hyper, epoch):
+    def _apply32(self, p, g, state, hyper, epoch):
         d1, d2 = hyper.beta1, hyper.beta2
         g = hyper.clip(g)
         if hyper.wd > 0.0:

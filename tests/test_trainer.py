@@ -280,3 +280,40 @@ def test_load_model_applies_config_overrides(tmp_path):
     assert h.base_lr == 0.001
     assert h.wd == 0.125
     assert t2.hypers[t2._resolve_param_key("fc1")]["bias"].wd != 0.125
+
+
+def test_update_many_matches_update_loop():
+    """update_many(k) must reproduce the exact parameter/optimizer
+    trajectory of k update() calls, including the per-step PRNG keys
+    (dropout nets would silently diverge on an RNG mismatch)."""
+    conf = MLP_CONF + "\nsilent = 1\n"
+    # a dropout layer makes the equivalence sensitive to the RNG stream
+    conf = conf.replace("layer[+1:ac1] = relu",
+                        "layer[+1:ac1] = relu\nlayer[+0] = dropout\n"
+                        "  threshold = 0.25")
+    t1 = make_trainer(conf, extra=[("seed", "3")])
+    t2 = make_trainer(conf, extra=[("seed", "3")])
+    rnd = np.random.RandomState(0)
+    k, bs = 4, 32
+    datas = rnd.rand(k, bs, 1, 1, 8).astype(np.float32)
+    labels = rnd.randint(0, 2, (k, bs, 1)).astype(np.float32)
+    t1.start_round(1)
+    t2.start_round(1)
+    for i in range(k):
+        t1.update(DataBatch(data=datas[i], label=labels[i],
+                            index=np.arange(bs, dtype=np.uint32)))
+    losses = t2.update_many(datas, labels)
+    assert losses.shape == (k,)
+    np.testing.assert_array_equal(t1.get_weight("fc1", "wmat"),
+                                  t2.get_weight("fc1", "wmat"))
+    np.testing.assert_array_equal(t1.get_weight("fc2", "bias"),
+                                  t2.get_weight("fc2", "bias"))
+    np.testing.assert_allclose(float(np.asarray(t1._last_loss)),
+                               float(np.asarray(losses[-1])), rtol=1e-6)
+    # mixing the APIs must continue the same trajectory
+    t1.update(DataBatch(data=datas[0], label=labels[0],
+                        index=np.arange(bs, dtype=np.uint32)))
+    t2.update(DataBatch(data=datas[0], label=labels[0],
+                        index=np.arange(bs, dtype=np.uint32)))
+    np.testing.assert_array_equal(t1.get_weight("fc1", "wmat"),
+                                  t2.get_weight("fc1", "wmat"))
