@@ -30,6 +30,12 @@ def create_iterator(cfg: List[Tuple[str, str]]) -> IIterator:
             elif val == "imgbin" or val == "imgbinx":
                 assert it is None, "imgbin cannot chain over another iterator"
                 it = BatchAdaptIterator(AugmentIterator(ImageBinIterator()))
+            elif val == "imbin_native":
+                # C++ loader: decode + normalize + batch assembly off-Python
+                from .native import NativeImageBinIterator
+                assert it is None, \
+                    "imbin_native cannot chain over another iterator"
+                it = NativeImageBinIterator()
             elif val == "img":
                 assert it is None, "img cannot chain over another iterator"
                 it = BatchAdaptIterator(AugmentIterator(ImageIterator()))

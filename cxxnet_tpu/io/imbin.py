@@ -179,10 +179,16 @@ class ImageBinIterator(IIterator):
         self.indices: List[int] = []
         for lst in self.lsts:
             with open(lst) as f:
-                for line in f:
+                for lineno, line in enumerate(f, 1):
                     toks = line.split()
+                    if not toks:
+                        continue  # blank line
                     if len(toks) < 3:
-                        continue
+                        # silently skipping would desynchronize the
+                        # label/record lockstep pairing for the whole shard
+                        raise ValueError(
+                            f"{lst} line {lineno}: expected 'index label... "
+                            f"filename' (got {len(toks)} tokens)")
                     self.indices.append(int(toks[0]))
                     self.labels.append(
                         np.array([float(t) for t in

@@ -1,0 +1,153 @@
+// CXTPUBIN paged binary pack format, C++ side.
+//
+// Byte-compatible with the Python implementation (cxxnet_tpu/io/imbin.py):
+//   file   := magic "CXTPUBIN" | u32 version | u64 page_size | page*
+//   page   := u32 nrec | nrec * (u32 len | len bytes) | zero pad to page_size
+// The fixed-size-page design mirrors the reference's BinaryPage
+// (src/utils/io.h:254-326): sequential 64MB reads keep the disk/page-cache
+// pipeline full regardless of record size.
+#ifndef CXXNET_NATIVE_BINPAGE_H_
+#define CXXNET_NATIVE_BINPAGE_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace cxn {
+
+constexpr char kMagic[8] = {'C', 'X', 'T', 'P', 'U', 'B', 'I', 'N'};
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kDefaultPageSize = 64ull << 20;
+
+class BinPageWriter {
+ public:
+  bool Open(const std::string& path, uint64_t page_size = kDefaultPageSize,
+            std::string* err = nullptr) {
+    page_size_ = page_size;
+    f_ = std::fopen(path.c_str(), "wb");
+    if (!f_) {
+      if (err) *err = "cannot open " + path;
+      return false;
+    }
+    std::fwrite(kMagic, 1, 8, f_);
+    std::fwrite(&kVersion, 4, 1, f_);
+    std::fwrite(&page_size_, 8, 1, f_);
+    used_ = 4;
+    return true;
+  }
+  bool Push(const void* data, uint32_t len, std::string* err = nullptr) {
+    uint64_t need = 4ull + len;
+    if (need + 4 > page_size_) {
+      if (err) *err = "record of " + std::to_string(len) +
+                      " bytes exceeds page size";
+      return false;
+    }
+    if (used_ + need > page_size_) FlushPage();
+    recs_.insert(recs_.end(), (const char*)&len, (const char*)&len + 4);
+    recs_.insert(recs_.end(), (const char*)data, (const char*)data + len);
+    ++nrec_;
+    used_ += need;
+    return true;
+  }
+  void Close() {
+    if (!f_) return;
+    if (nrec_ > 0) FlushPage();
+    std::fclose(f_);
+    f_ = nullptr;
+  }
+  ~BinPageWriter() { Close(); }
+
+ private:
+  void FlushPage() {
+    std::vector<char> page(page_size_, 0);
+    std::memcpy(page.data(), &nrec_, 4);
+    std::memcpy(page.data() + 4, recs_.data(), recs_.size());
+    std::fwrite(page.data(), 1, page_size_, f_);
+    recs_.clear();
+    nrec_ = 0;
+    used_ = 4;
+  }
+  std::FILE* f_ = nullptr;
+  uint64_t page_size_ = kDefaultPageSize;
+  uint64_t used_ = 4;
+  uint32_t nrec_ = 0;
+  std::vector<char> recs_;
+};
+
+// One decoded page: raw record bytes.
+struct Page {
+  std::vector<std::vector<char>> recs;
+};
+
+class BinPageReader {
+ public:
+  bool Open(const std::string& path, std::string* err) {
+    f_ = std::fopen(path.c_str(), "rb");
+    if (!f_) {
+      *err = "cannot open " + path;
+      return false;
+    }
+    char magic[8];
+    uint32_t version = 0;
+    if (std::fread(magic, 1, 8, f_) != 8 ||
+        std::memcmp(magic, kMagic, 8) != 0) {
+      *err = path + ": not a CXTPUBIN file";
+      return false;
+    }
+    if (std::fread(&version, 4, 1, f_) != 1 || version != kVersion) {
+      *err = path + ": bad version";
+      return false;
+    }
+    if (std::fread(&page_size_, 8, 1, f_) != 1) {
+      *err = path + ": truncated header";
+      return false;
+    }
+    buf_.resize(page_size_);
+    return true;
+  }
+  // false = EOF (or error with *err set)
+  bool NextPage(Page* out, std::string* err) {
+    size_t got = std::fread(buf_.data(), 1, page_size_, f_);
+    if (got == 0) return false;
+    if (got != page_size_) {
+      *err = "truncated page";
+      return false;
+    }
+    uint32_t nrec;
+    std::memcpy(&nrec, buf_.data(), 4);
+    uint64_t off = 4;
+    out->recs.clear();
+    out->recs.reserve(nrec);
+    for (uint32_t i = 0; i < nrec; ++i) {
+      uint32_t len;
+      if (off + 4 > page_size_) {
+        *err = "corrupt page (offset overflow)";
+        return false;
+      }
+      std::memcpy(&len, buf_.data() + off, 4);
+      off += 4;
+      if (off + len > page_size_) {
+        *err = "corrupt page (record overflow)";
+        return false;
+      }
+      out->recs.emplace_back(buf_.data() + off, buf_.data() + off + len);
+      off += len;
+    }
+    return true;
+  }
+  void Close() {
+    if (f_) std::fclose(f_);
+    f_ = nullptr;
+  }
+  ~BinPageReader() { Close(); }
+
+ private:
+  std::FILE* f_ = nullptr;
+  uint64_t page_size_ = 0;
+  std::vector<char> buf_;
+};
+
+}  // namespace cxn
+#endif  // CXXNET_NATIVE_BINPAGE_H_

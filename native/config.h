@@ -1,0 +1,91 @@
+// Key=value config parser, C++ side.
+//
+// Parity with the reference's ConfigReaderBase (src/utils/config.h:20-189):
+// "name = value" lines, '#' comments, double-quoted values (quotes
+// stripped), later pairs win when queried via last().  The same config text
+// that drives the Python side drives the native loader, preserving the
+// reference's single-config-language design (SURVEY.md §5.6).
+#ifndef CXXNET_NATIVE_CONFIG_H_
+#define CXXNET_NATIVE_CONFIG_H_
+
+#include <cstdlib>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace cxn {
+
+class Config {
+ public:
+  // parse "k = v" lines from text; returns false + sets err on bad syntax
+  bool Parse(const std::string& text, std::string* err) {
+    size_t pos = 0;
+    int lineno = 0;
+    while (pos < text.size()) {
+      size_t eol = text.find('\n', pos);
+      if (eol == std::string::npos) eol = text.size();
+      std::string line = text.substr(pos, eol - pos);
+      pos = eol + 1;
+      ++lineno;
+      size_t hash = line.find('#');
+      if (hash != std::string::npos) line = line.substr(0, hash);
+      line = Trim(line);
+      if (line.empty()) continue;
+      size_t eq = line.find('=');
+      if (eq == std::string::npos) {
+        *err = "config line " + std::to_string(lineno) + ": missing '='";
+        return false;
+      }
+      std::string k = Trim(line.substr(0, eq));
+      std::string v = Trim(line.substr(eq + 1));
+      if (v.size() >= 2 && v.front() == '"' && v.back() == '"')
+        v = v.substr(1, v.size() - 2);
+      if (k.empty()) {
+        *err = "config line " + std::to_string(lineno) + ": empty key";
+        return false;
+      }
+      pairs_.emplace_back(k, v);
+    }
+    return true;
+  }
+
+  // last value for key, or fallback
+  std::string Get(const std::string& key, const std::string& dflt = "") const {
+    for (auto it = pairs_.rbegin(); it != pairs_.rend(); ++it)
+      if (it->first == key) return it->second;
+    return dflt;
+  }
+  // non-throwing: a malformed number keeps the default (callers validate
+  // required keys separately; nothing here may throw across the C ABI)
+  long GetInt(const std::string& key, long dflt) const {
+    std::string v = Get(key);
+    if (v.empty()) return dflt;
+    char* end = nullptr;
+    long r = std::strtol(v.c_str(), &end, 10);
+    return (end && end != v.c_str()) ? r : dflt;
+  }
+  double GetFloat(const std::string& key, double dflt) const {
+    std::string v = Get(key);
+    if (v.empty()) return dflt;
+    char* end = nullptr;
+    double r = std::strtod(v.c_str(), &end);
+    return (end && end != v.c_str()) ? r : dflt;
+  }
+  bool Has(const std::string& key) const { return !Get(key).empty(); }
+
+  const std::vector<std::pair<std::string, std::string>>& pairs() const {
+    return pairs_;
+  }
+
+ private:
+  static std::string Trim(const std::string& s) {
+    size_t a = s.find_first_not_of(" \t\r");
+    if (a == std::string::npos) return "";
+    size_t b = s.find_last_not_of(" \t\r");
+    return s.substr(a, b - a + 1);
+  }
+  std::vector<std::pair<std::string, std::string>> pairs_;
+};
+
+}  // namespace cxn
+#endif  // CXXNET_NATIVE_CONFIG_H_

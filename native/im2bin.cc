@@ -1,0 +1,72 @@
+// im2bin: pack files listed in a .lst into a CXTPUBIN page file.
+//
+// Reference: tools/im2bin.cpp:6-67.  List line format is the reference's
+// "index<TAB>label...<TAB>filename"; the payload is the file's raw bytes
+// (jpeg, raw u8 CHW, or raw f32 CHW — the reader's decode rules pick the
+// format per record).
+//
+//   im2bin <image.lst> <image_root_dir> <out.bin> [page_size_bytes]
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "binpage.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: im2bin image.lst image_root out.bin [page_size]\n");
+    return 1;
+  }
+  uint64_t page_size = cxn::kDefaultPageSize;
+  if (argc > 4) page_size = std::strtoull(argv[4], nullptr, 10);
+  std::string err;
+  cxn::BinPageWriter w;
+  if (!w.Open(argv[3], page_size, &err)) {
+    std::fprintf(stderr, "im2bin: %s\n", err.c_str());
+    return 1;
+  }
+  std::FILE* lst = std::fopen(argv[1], "r");
+  if (!lst) {
+    std::fprintf(stderr, "im2bin: cannot open %s\n", argv[1]);
+    return 1;
+  }
+  char line[65536];
+  long n = 0;
+  std::vector<char> buf;
+  while (std::fgets(line, sizeof line, lst)) {
+    // last token = filename
+    std::vector<std::string> toks;
+    for (char* p = std::strtok(line, " \t\r\n"); p;
+         p = std::strtok(nullptr, " \t\r\n"))
+      toks.emplace_back(p);
+    if (toks.size() < 3) continue;
+    std::string path = std::string(argv[2]) + "/" + toks.back();
+    std::FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) {
+      std::fprintf(stderr, "im2bin: cannot open %s\n", path.c_str());
+      return 1;
+    }
+    std::fseek(f, 0, SEEK_END);
+    long len = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    buf.resize(len);
+    if (std::fread(buf.data(), 1, len, f) != (size_t)len) {
+      std::fprintf(stderr, "im2bin: short read on %s\n", path.c_str());
+      return 1;
+    }
+    std::fclose(f);
+    if (!w.Push(buf.data(), (uint32_t)len, &err)) {
+      std::fprintf(stderr, "im2bin: %s\n", err.c_str());
+      return 1;
+    }
+    ++n;
+    if (n % 1000 == 0) std::fprintf(stderr, "im2bin: %ld packed\n", n);
+  }
+  std::fclose(lst);
+  w.Close();
+  std::fprintf(stderr, "im2bin: packed %ld records into %s\n", n, argv[3]);
+  return 0;
+}

@@ -1,0 +1,61 @@
+// Bounded producer/consumer queue: the ThreadBuffer equivalent.
+//
+// The reference's ThreadBuffer (src/utils/thread_buffer.h:22-202) is a
+// semaphore-protocol double buffer over an ElemFactory concept; this is the
+// same idea with std::mutex/condition_variable and a generation counter so
+// BeforeFirst can orphan a stale producer without deadlocking (the producer
+// rechecks the generation on every blocked push).
+#ifndef CXXNET_NATIVE_THREAD_BUFFER_H_
+#define CXXNET_NATIVE_THREAD_BUFFER_H_
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+namespace cxn {
+
+template <typename T>
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap = 2) : cap_(cap) {}
+
+  // returns false if the generation changed (producer must exit)
+  bool Push(T&& item, uint64_t gen) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || gen_ != gen; });
+    if (gen_ != gen) return false;
+    q_.emplace_back(std::move(item));
+    not_empty_.notify_one();
+    return true;
+  }
+  // blocking pop; assumes a producer of the current generation is running
+  T Pop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty(); });
+    T item = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return item;
+  }
+  // bump generation and clear: wakes blocked producers so they can exit
+  void Reset(uint64_t new_gen) {
+    std::lock_guard<std::mutex> lk(mu_);
+    gen_ = new_gen;
+    q_.clear();
+    not_full_.notify_all();
+  }
+  uint64_t gen() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return gen_;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::condition_variable not_full_, not_empty_;
+  std::deque<T> q_;
+  size_t cap_;
+  uint64_t gen_ = 0;
+};
+
+}  // namespace cxn
+#endif  // CXXNET_NATIVE_THREAD_BUFFER_H_

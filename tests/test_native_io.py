@@ -1,0 +1,328 @@
+"""Native (C++) data loader tests: format interop with the Python writer,
+label pairing under shuffle, round_batch padding protocol, sharded reads,
+and the im2bin packer binary."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.factory import create_iterator, init_iterator
+from cxxnet_tpu.io.imbin import BinaryPageWriter
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+def _have_toolchain():
+    try:
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                       capture_output=True)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _have_toolchain(),
+                                reason="no native toolchain")
+
+
+def write_dataset(tmp_path, n=23, c=3, h=8, w=8, page_size=1 << 12,
+                  dtype="u8", nshard=1):
+    """Pack n deterministic instances; instance i has data filled with
+    (i % 251) and label [i, i*2]."""
+    rnd = np.random.RandomState(5)
+    per = (n + nshard - 1) // nshard
+    paths = []
+    for s in range(nshard):
+        bin_p = str(tmp_path / f"d{s}.bin")
+        lst_p = str(tmp_path / f"d{s}.lst")
+        wtr = BinaryPageWriter(bin_p, page_size=page_size)
+        with open(lst_p, "w") as lf:
+            for i in range(s * per, min(n, (s + 1) * per)):
+                if dtype == "u8":
+                    payload = np.full(c * h * w, i % 251, np.uint8).tobytes()
+                else:
+                    payload = (np.full(c * h * w, i, np.float32)
+                               + 0.25).tobytes()
+                wtr.push(payload)
+                lf.write(f"{i}\t{float(i)}\t{float(i * 2)}\tf{i}.bin\n")
+        wtr.close()
+        paths.append((bin_p, lst_p))
+    return paths
+
+
+def make_native(tmp_path, extra="", nshard=1, **kw):
+    paths = write_dataset(tmp_path, nshard=nshard, **kw)
+    if nshard == 1:
+        pb, pl = paths[0]
+        binspec, lstspec = pb, pl
+        count = ""
+    else:
+        binspec = str(tmp_path / "d%d.bin")
+        lstspec = str(tmp_path / "d%d.lst")
+        count = f"imgbin_count = {nshard}\n"
+    cfg = [("iter", "imbin_native")]
+    conf_text = f"""
+path_imgbin = {binspec}
+path_imglst = {lstspec}
+{count}label_width = 2
+input_shape = 3,8,8
+silent = 1
+{extra}
+"""
+    for line in conf_text.strip().splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            cfg.append((k.strip(), v.strip()))
+    it = create_iterator(cfg)
+    return init_iterator(it, [("batch_size", "4")])
+
+
+def collect_epoch(it):
+    batches = []
+    it.before_first()
+    while True:
+        b = it.next()
+        if b is None:
+            return batches
+        batches.append(b)
+
+
+def test_native_basic_contents(tmp_path):
+    it = make_native(tmp_path)
+    batches = collect_epoch(it)
+    # 23 instances, batch 4, tail dropped without round_batch -> 5 batches
+    assert len(batches) == 5
+    seen = {}
+    for b in batches:
+        assert b.data.shape == (4, 3, 8, 8)
+        assert b.label.shape == (4, 2)
+        assert b.num_batch_padd == 0
+        for j in range(4):
+            i = int(b.index[j])
+            seen[i] = (b.data[j], b.label[j])
+    assert len(seen) == 20
+    for i, (d, l) in seen.items():
+        np.testing.assert_array_equal(d, np.full((3, 8, 8), i % 251,
+                                                 np.float32))
+        np.testing.assert_array_equal(l, [i, 2 * i])
+    # second epoch identical
+    assert len(collect_epoch(it)) == 5
+
+
+def test_native_round_batch_and_f32(tmp_path):
+    it = make_native(tmp_path, extra="round_batch = 1", dtype="f32")
+    batches = collect_epoch(it)
+    assert len(batches) == 6
+    assert batches[-1].num_batch_padd == 1  # 23 = 5*4 + 3 -> pad 1
+    for b in batches:
+        for j in range(4):
+            i = int(b.index[j])
+            np.testing.assert_allclose(b.data[j],
+                                       np.full((3, 8, 8), i + 0.25), rtol=0)
+
+
+def test_native_shuffle_pairs_labels(tmp_path):
+    it = make_native(tmp_path, extra="shuffle = 1\nround_batch = 1", n=37)
+    seen = set()
+    for b in collect_epoch(it):
+        for j in range(4 - b.num_batch_padd):
+            i = int(b.index[j])
+            np.testing.assert_array_equal(b.label[j], [i, 2 * i])
+            np.testing.assert_array_equal(
+                b.data[j], np.full((3, 8, 8), i % 251, np.float32))
+            assert i not in seen
+            seen.add(i)
+    assert seen == set(range(37))
+
+
+def test_native_mean_scale(tmp_path):
+    it = make_native(tmp_path, extra="mean_value = 1,2,3\nscale = 0.5", n=8)
+    b = collect_epoch(it)[0]
+    i = int(b.index[0])
+    expect = (np.full((3, 8, 8), i % 251, np.float32)
+              - np.array([1, 2, 3], np.float32)[:, None, None]) * 0.5
+    np.testing.assert_allclose(b.data[0], expect)
+
+
+def test_native_sharded(tmp_path):
+    it = make_native(tmp_path, nshard=3, n=24, extra="round_batch = 1")
+    seen = set()
+    for b in collect_epoch(it):
+        for j in range(4 - b.num_batch_padd):
+            i = int(b.index[j])
+            np.testing.assert_array_equal(b.label[j], [i, 2 * i])
+            seen.add(i)
+    assert seen == set(range(24))
+
+
+def test_native_worker_sharding(tmp_path):
+    """dist_num_worker/dist_worker_rank split shards across workers."""
+    write_dataset(tmp_path, n=24, nshard=4)
+    got = set()
+    for rank in (0, 1):
+        cfg = [("iter", "imbin_native"),
+               ("path_imgbin", str(tmp_path / "d%d.bin")),
+               ("path_imglst", str(tmp_path / "d%d.lst")),
+               ("imgbin_count", "4"), ("label_width", "2"),
+               ("input_shape", "3,8,8"), ("silent", "1"),
+               ("dist_num_worker", "2"), ("dist_worker_rank", str(rank)),
+               ("round_batch", "1")]
+        it = init_iterator(create_iterator(cfg), [("batch_size", "4")])
+        ranks_seen = set()
+        for b in collect_epoch(it):
+            for j in range(4 - b.num_batch_padd):
+                ranks_seen.add(int(b.index[j]))
+        assert len(ranks_seen) == 12
+        got |= ranks_seen
+    assert got == set(range(24))
+
+
+def test_native_jpeg_records(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    bin_p = str(tmp_path / "j.bin")
+    lst_p = str(tmp_path / "j.lst")
+    rnd = np.random.RandomState(0)
+    w = BinaryPageWriter(bin_p, page_size=1 << 14)
+    imgs = []
+    with open(lst_p, "w") as lf:
+        for i in range(6):
+            img = (rnd.rand(8, 8, 3) * 255).astype(np.uint8)
+            ok, enc = cv2.imencode(".jpg", img,
+                                   [cv2.IMWRITE_JPEG_QUALITY, 95])
+            assert ok
+            w.push(enc.tobytes())
+            imgs.append(img)
+            lf.write(f"{i}\t{float(i)}\tf{i}.jpg\n")
+    w.close()
+    cfg = [("iter", "imbin_native"), ("path_imgbin", bin_p),
+           ("path_imglst", lst_p), ("input_shape", "3,8,8"), ("silent", "1")]
+    it = init_iterator(create_iterator(cfg), [("batch_size", "3")])
+    batches = collect_epoch(it)
+    assert len(batches) == 2
+    for b in batches:
+        for j in range(3):
+            i = int(b.index[j])
+            # libjpeg decodes RGB; cv2 encoded BGR -> compare via cv2 RGB
+            ref = cv2.cvtColor(cv2.imdecode(
+                np.frombuffer(
+                    cv2.imencode(".jpg", imgs[i],
+                                 [cv2.IMWRITE_JPEG_QUALITY, 95])[1], np.uint8),
+                cv2.IMREAD_COLOR), cv2.COLOR_BGR2RGB)
+            np.testing.assert_allclose(
+                b.data[j], ref.transpose(2, 0, 1).astype(np.float32),
+                atol=16)  # decoder rounding differences
+
+
+def test_im2bin_binary_roundtrip(tmp_path):
+    """The C++ im2bin packer output is readable by the native iterator."""
+    raw_dir = tmp_path / "raw"
+    raw_dir.mkdir()
+    lst_p = str(tmp_path / "pack.lst")
+    with open(lst_p, "w") as lf:
+        for i in range(5):
+            blob = np.full(3 * 8 * 8, i + 10, np.uint8)
+            with open(raw_dir / f"f{i}.raw", "wb") as f:
+                f.write(blob.tobytes())
+            lf.write(f"{i}\t{float(i)}\tf{i}.raw\n")
+    bin_p = str(tmp_path / "pack.bin")
+    subprocess.run([os.path.join(NATIVE_DIR, "im2bin"), lst_p, str(raw_dir),
+                    bin_p, "4096"], check=True, capture_output=True)
+    cfg = [("iter", "imbin_native"), ("path_imgbin", bin_p),
+           ("path_imglst", lst_p), ("input_shape", "3,8,8"),
+           ("silent", "1"), ("round_batch", "1")]
+    it = init_iterator(create_iterator(cfg), [("batch_size", "2")])
+    seen = set()
+    for b in collect_epoch(it):
+        for j in range(2 - b.num_batch_padd):
+            i = int(b.index[j])
+            np.testing.assert_array_equal(
+                b.data[j], np.full((3, 8, 8), i + 10, np.float32))
+            seen.add(i)
+    assert seen == set(range(5))
+
+
+def test_native_trains_net(tmp_path):
+    """End-to-end: native loader feeding the jitted trainer."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    write_dataset(tmp_path, n=32, c=3, h=8, w=8)
+    conf = [("iter", "imbin_native"),
+            ("path_imgbin", str(tmp_path / "d0.bin")),
+            ("path_imglst", str(tmp_path / "d0.lst")),
+            ("input_shape", "3,8,8"), ("silent", "1"),
+            ("label_width", "2"), ("round_batch", "1"),
+            ("scale", "0.01")]
+    it = init_iterator(create_iterator(conf), [("batch_size", "8")])
+    net_conf = """
+netconfig=start
+layer[0->1] = flatten
+layer[1->2] = fullc:fc
+  nhidden = 4
+layer[2->2] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+silent = 1
+"""
+    from cxxnet_tpu.utils.config import parse_config_string
+    t = NetTrainer()
+    for k, v in parse_config_string(net_conf):
+        t.set_param(k, v)
+    t.init_model()
+    t.start_round(1)
+    from cxxnet_tpu.io.data import DataBatch
+    losses = []
+    for _ in range(4):
+        for b in it:
+            # class = instance index % 4
+            lb = DataBatch(data=b.data, label=b.label[:, :1] % 4,
+                           index=b.index, num_batch_padd=b.num_batch_padd)
+            t.update(lb)
+            losses.append(float(np.asarray(t._last_loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_native_malformed_lst_is_error(tmp_path):
+    """1-2 token lines must fail init, not silently desync label pairing."""
+    write_dataset(tmp_path, n=6)
+    lst = tmp_path / "d0.lst"
+    lines = lst.read_text().splitlines()
+    lines[2] = "2 2.0"  # drop the filename token
+    lst.write_text("\n".join(lines) + "\n")
+    cfg = [("iter", "imbin_native"), ("path_imgbin", str(tmp_path / "d0.bin")),
+           ("path_imglst", str(lst)), ("label_width", "2"),
+           ("input_shape", "3,8,8"), ("silent", "1")]
+    with pytest.raises(RuntimeError, match="line 3"):
+        init_iterator(create_iterator(cfg), [("batch_size", "2")])
+
+
+def test_native_rejects_augmentation_keys(tmp_path):
+    """Augmentation config must fail loudly, not silently train without it."""
+    write_dataset(tmp_path, n=6)
+    cfg = [("iter", "imbin_native"), ("path_imgbin", str(tmp_path / "d0.bin")),
+           ("path_imglst", str(tmp_path / "d0.lst")), ("label_width", "2"),
+           ("input_shape", "3,8,8"), ("silent", "1"), ("rand_mirror", "1")]
+    with pytest.raises(RuntimeError, match="rand_mirror"):
+        init_iterator(create_iterator(cfg), [("batch_size", "2")])
+
+
+def test_native_error_cleared_on_restart(tmp_path):
+    """A failed epoch's error must not poison a later epoch's normal end."""
+    it = make_native(tmp_path, n=3)  # 3 insts < batch 4, round_batch off
+    # first epoch: dataset smaller than one batch and round_batch=0 -> just
+    # an empty epoch, no error; now force an error epoch via a dataset that
+    # trips round_batch wrap with too few instances
+    (tmp_path / "b").mkdir()
+    it2 = make_native(tmp_path / "b", n=1, extra="round_batch = 1")
+    it2.before_first()
+    with pytest.raises(RuntimeError, match="smaller than batch"):
+        while it2.next() is not None:
+            pass
+    # restart: same data still errors (dataset is still too small), but a
+    # fresh iterator over good data must end cleanly after an earlier error
+    it.before_first()
+    assert it.next() is None  # empty epoch, clean end, no stale error
