@@ -109,7 +109,33 @@ class LearnTask:
         self.start_counter = s
         return True
 
+    def _maybe_init_distributed(self) -> None:
+        """Join the JAX distributed runtime when a coordinator is configured
+        (config keys dist_coordinator/dist_num_proc/dist_proc_rank; env vars
+        CXN_COORDINATOR/CXN_NUM_PROC/CXN_PROC_RANK override so one config
+        file serves every worker, like the reference's dist launcher —
+        example/MNIST/mpi.conf, nnet_ps_server.cpp:41-48)."""
+        cfg = dict(self.cfg)
+        coord = os.environ.get("CXN_COORDINATOR",
+                               cfg.get("dist_coordinator", ""))
+        if not coord:
+            return
+        nproc = int(os.environ.get("CXN_NUM_PROC",
+                                   cfg.get("dist_num_proc", "1")))
+        rank = int(os.environ.get("CXN_PROC_RANK",
+                                  cfg.get("dist_proc_rank", "0")))
+        from .parallel import mesh as meshlib
+        meshlib.init_distributed(coord, nproc, rank)
+        # shard the data pipeline by process unless the config did already
+        if "dist_num_worker" not in cfg:
+            self.set_param("dist_num_worker", str(nproc))
+            self.set_param("dist_worker_rank", str(rank))
+        if not self.silent:
+            print(f"distributed: rank {rank}/{nproc} via {coord}, "
+                  f"{len(__import__('jax').devices())} global devices")
+
     def init(self) -> None:
+        self._maybe_init_distributed()
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
                 print(f"Init: Continue training from round {self.start_counter}")

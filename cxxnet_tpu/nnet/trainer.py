@@ -124,7 +124,13 @@ class NetTrainer:
         netcfg.configure(self.cfg)
         assert self.batch_size > 0, "batch_size must be set"
         self.netcfg = netcfg
-        self.devices = meshlib.select_devices(self.dev)
+        if jax.process_count() > 1:
+            # multi-host: the mesh must span the global device set; local
+            # id selection (dev = tpu:0-3) only makes sense single-host
+            self.devices = meshlib.global_devices_for(
+                meshlib.parse_device_spec(self.dev)["platform"])
+        else:
+            self.devices = meshlib.select_devices(self.dev)
         if self.mesh_spec is None and len(self.devices) > 1:
             self.mesh_spec = meshlib.MeshSpec({"data": len(self.devices)})
         self.mesh = meshlib.build_mesh(
@@ -407,6 +413,23 @@ class NetTrainer:
         self.round = r
         self.train_metric.clear()
 
+    def _device_batch(self, arr, dtype=None):
+        """Host batch -> device array under the batch sharding.
+
+        Single-process: plain transfer (XLA shards it).  Multi-host: each
+        process holds only its slice of the global batch (the data iterator
+        sharded by dist_worker_rank), so assemble the global array from
+        process-local data — the SPMD program then sees one logical
+        (global_batch, ...) input, exactly like single-host."""
+        if isinstance(arr, jax.Array) and not isinstance(arr, np.ndarray):
+            return arr.astype(dtype) if dtype and arr.dtype != dtype else arr
+        arr = np.asarray(arr, dtype) if dtype else np.asarray(arr)
+        if jax.process_count() > 1:
+            global_shape = (self.batch_size,) + arr.shape[1:]
+            return jax.make_array_from_process_local_data(
+                self.batch_shard, arr, global_shape)
+        return jnp.asarray(arr)
+
     def _grad_acc_init(self):
         return jax.tree.map(jnp.zeros_like, self.params)
 
@@ -417,9 +440,9 @@ class NetTrainer:
         if do_update:
             self.epoch_counter += 1
         rng = jax.random.fold_in(self._rng_base, self.sample_counter)
-        data = jnp.asarray(batch.data)
-        label_vec = jnp.asarray(batch.label, jnp.float32)
-        extras = tuple(jnp.asarray(e) for e in batch.extra_data)
+        data = self._device_batch(batch.data)
+        label_vec = self._device_batch(batch.label, jnp.float32)
+        extras = tuple(self._device_batch(e) for e in batch.extra_data)
         if self.update_period > 1:
             if getattr(self, "_grad_acc", None) is None:
                 self._grad_acc = self._grad_acc_init()
@@ -448,8 +471,9 @@ class NetTrainer:
         estep = self._get_eval_step(node_ids)
         for batch in data_iter:
             outs = estep(self.params, self.buffers,
-                         jnp.asarray(batch.data),
-                         tuple(jnp.asarray(e) for e in batch.extra_data))
+                         self._device_batch(batch.data),
+                         tuple(self._device_batch(e)
+                               for e in batch.extra_data))
             n_valid = batch.batch_size - batch.num_batch_padd
             preds = [np.asarray(outs[nid])[:n_valid]
                      for nid in self.eval_node_ids]
@@ -475,15 +499,17 @@ class NetTrainer:
     def predict_raw(self, batch: DataBatch) -> np.ndarray:
         nid = self.net.final_node
         estep = self._get_eval_step((nid,))
-        outs = estep(self.params, self.buffers, jnp.asarray(batch.data),
-                     tuple(jnp.asarray(e) for e in batch.extra_data))
+        outs = estep(self.params, self.buffers,
+                     self._device_batch(batch.data),
+                     tuple(self._device_batch(e) for e in batch.extra_data))
         return np.asarray(outs[nid])
 
     def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
         nid = self.net.node_id(node_name)
         estep = self._get_eval_step((nid,))
-        outs = estep(self.params, self.buffers, jnp.asarray(batch.data),
-                     tuple(jnp.asarray(e) for e in batch.extra_data))
+        outs = estep(self.params, self.buffers,
+                     self._device_batch(batch.data),
+                     tuple(self._device_batch(e) for e in batch.extra_data))
         n_valid = batch.batch_size - batch.num_batch_padd
         return np.asarray(outs[nid])[:n_valid]
 

@@ -117,3 +117,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_pspec(mesh))
+
+
+def init_distributed(coordinator: str, num_processes: int, process_id: int,
+                     local_device_ids=None) -> None:
+    """Multi-host bring-up: join the JAX distributed runtime so all
+    processes see one global device set and compiled programs run SPMD
+    across hosts (collectives ride ICI within a slice, DCN across).
+
+    This replaces the reference's parameter-server topology
+    (``param_server = dist`` + launcher, nnet_ps_server.cpp:162-170): there
+    is no server process — every host runs the same program on its shard of
+    the global mesh.  Config keys (see main.py): ``dist_coordinator``
+    (host:port of process 0), ``dist_num_proc``, ``dist_proc_rank``; the
+    env vars CXN_COORDINATOR / CXN_NUM_PROC / CXN_PROC_RANK override, so
+    one config file serves every worker like the reference's single conf
+    (nnet_ps_server.cpp:41-48).
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def global_devices_for(platform: str) -> List[jax.Device]:
+    """All devices across processes for a platform (multi-host meshes need
+    the global list; jax.devices() is already global after
+    init_distributed)."""
+    try:
+        return list(jax.devices(platform))
+    except RuntimeError:
+        return list(jax.devices())
