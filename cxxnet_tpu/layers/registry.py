@@ -46,6 +46,16 @@ for _cls in (ReluLayer, SigmoidLayer, TanhLayer, SoftplusLayer, XeluLayer,
     register(_cls)
 
 
+def _torch_plugin_factory() -> Layer:
+    # plugin layer (caffe-adapter analogue); imported lazily so torch stays
+    # off the import path of ordinary runs
+    from ..plugin.torch_adapter import TorchLayer
+    return TorchLayer()
+
+
+_REGISTRY["torch"] = _torch_plugin_factory
+
+
 def layer_type_names():
     return sorted(_REGISTRY)
 
@@ -62,4 +72,5 @@ def create_layer(type_name: str) -> Layer:
     if type_name not in _REGISTRY:
         raise ValueError(f"unknown layer type: {type_name!r}; "
                          f"known: {layer_type_names()}")
-    return _REGISTRY[type_name]()
+    entry = _REGISTRY[type_name]
+    return entry()

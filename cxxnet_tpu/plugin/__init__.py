@@ -1,0 +1,15 @@
+"""Plugin layers adapting external frameworks behind the Layer interface.
+
+Reference: ``src/plugin/caffe_adapter-inl.hpp`` — cxxnet wraps ``caffe::Layer``
+objects behind ``ILayer`` so Caffe's implementations can run inside a cxxnet
+net, primarily as a known-good oracle for PairTest differential testing
+(``caffe_adapter-inl.hpp:23-24``).  The TPU-native analogue wraps **torch**
+(CPU) modules: torch is the contemporary known-good reference, and the host
+round-trip the reference does per forward/backward (blob copies,
+``caffe_adapter-inl.hpp:67-129``) maps onto ``jax.pure_callback`` +
+``jax.custom_vjp``.
+"""
+
+from .torch_adapter import TorchLayer, torch_available
+
+__all__ = ["TorchLayer", "torch_available"]

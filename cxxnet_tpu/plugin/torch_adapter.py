@@ -1,0 +1,175 @@
+"""Torch plugin layer: run torch (CPU) ops inside the traced step.
+
+Reference: ``src/plugin/caffe_adapter-inl.hpp:26-228``.  The caffe adapter
+configures the wrapped layer from a ``proto=`` config string and copies blobs
+host<->device every Forward/Backprop; weights are exposed to the visitor as
+"blobN" (``:45-66``).  Here:
+
+* the wrapped op is chosen with ``op = conv|fullc|relu|sigmoid|tanh`` and
+  configured by the SAME hyperparameter keys as the native layer (shape
+  inference and parameter init are delegated to the native layer class, so
+  param tags/shapes/initialisation are identical — which is exactly what
+  makes ``pairtest-conv-torch`` style differential testing work with
+  master->slave weight sync);
+* the host round-trip is a ``jax.pure_callback`` (forward) plus a
+  ``jax.custom_vjp`` whose backward callback runs torch autograd — the
+  functional equivalent of the reference's per-step blob copies.
+
+torch never sees TPU memory; XLA stages the transfers around the callback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers.base import ForwardContext, Layer, Params, Shape4
+from ..layers.registry import create_layer
+
+# op name accepted in config -> native layer type it mirrors
+_SUPPORTED = {
+    "conv": "conv",
+    "fullc": "fullc",
+    "relu": "relu",
+    "sigmoid": "sigmoid",
+    "tanh": "tanh",
+}
+
+
+def torch_available() -> bool:
+    try:
+        import torch  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _torch_forward(op: str, hyper: dict, x: np.ndarray,
+                   tags: Tuple[str, ...], param_arrays: Tuple[np.ndarray, ...],
+                   need_grads: bool, gout: np.ndarray = None):
+    """Run the torch op on host. Returns out, or (gin, *gparams) when
+    need_grads (in tag order)."""
+    import torch
+    import torch.nn.functional as F
+
+    xt = torch.from_numpy(np.asarray(x, np.float32))
+    pt = {t: torch.from_numpy(np.asarray(a, np.float32))
+          for t, a in zip(tags, param_arrays)}
+    if need_grads:
+        xt.requires_grad_(True)
+        for v in pt.values():
+            v.requires_grad_(True)
+
+    if op == "conv":
+        out = F.conv2d(xt, pt["wmat"], pt.get("bias"),
+                       stride=hyper["stride"],
+                       padding=(hyper["pad_y"], hyper["pad_x"]),
+                       groups=hyper["num_group"])
+    elif op == "fullc":
+        out = F.linear(xt.reshape(xt.shape[0], -1), pt["wmat"], pt.get("bias"))
+        out = out.reshape(out.shape[0], 1, 1, out.shape[1])
+    elif op == "relu":
+        out = F.relu(xt)
+    elif op == "sigmoid":
+        out = torch.sigmoid(xt)
+    elif op == "tanh":
+        out = torch.tanh(xt)
+    else:
+        raise ValueError(f"torch adapter: unsupported op {op!r}")
+
+    if not need_grads:
+        return out.detach().numpy()
+    out.backward(torch.from_numpy(np.asarray(gout, np.float32)))
+    grads = [xt.grad.numpy()]
+    grads += [pt[t].grad.numpy() if pt[t].grad is not None
+              else np.zeros_like(param_arrays[i])
+              for i, t in enumerate(tags)]
+    return tuple(grads)
+
+
+class TorchLayer(Layer):
+    """``layer[...] = torch`` with ``op = <name>`` (caffe adapter analogue)."""
+
+    type_names = ("torch",)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.op = ""
+        self._proxy: Layer = None  # native layer mirrored for shapes/init
+
+    def _ensure_proxy(self) -> Layer:
+        if self._proxy is None:
+            if self.op not in _SUPPORTED:
+                raise ValueError(
+                    f"torch adapter: set op = one of {sorted(_SUPPORTED)}")
+            self._proxy = create_layer(_SUPPORTED[self.op])
+            self._proxy.param = self.param  # share hyperparams
+        return self._proxy
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "op":
+            self.op = val
+            return
+        super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        return self._ensure_proxy().infer_shapes(in_shapes)
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        return self._ensure_proxy().init_params(key, in_shapes, dtype)
+
+    def forward(self, params: Params, buffers: Params,
+                inputs: List[jnp.ndarray], ctx: ForwardContext):
+        self.check_n_inputs(inputs, 1)
+        if not torch_available():
+            raise RuntimeError("torch adapter requires torch")
+        x = inputs[0]
+        out_shape = self._ensure_proxy().infer_shapes([tuple(x.shape)])[0]
+        hyper = {"stride": self.param.stride, "pad_y": self.param.pad_y,
+                 "pad_x": self.param.pad_x, "num_group": self.param.num_group}
+        tags = tuple(sorted(params))
+        f = _make_callback_fn(self.op, tuple(sorted(hyper.items())), tags,
+                              tuple(out_shape))
+        out = f(x.astype(jnp.float32),
+                tuple(params[t].astype(jnp.float32) for t in tags))
+        return [out.astype(x.dtype)], buffers
+
+
+@functools.lru_cache(maxsize=None)
+def _make_callback_fn(op: str, hyper_items: tuple, tags: Tuple[str, ...],
+                      out_shape: Tuple[int, ...]):
+    """Build the custom_vjp'd host-callback function for one op config.
+
+    Cached on (op, hyperparams, tags, out shape) so retracing reuses the same
+    function object (keeps jax's custom_vjp caching effective).
+    """
+    hyper = dict(hyper_items)
+
+    def _fwd_host(x, *ps):
+        return _torch_forward(op, hyper, x, tags, ps, need_grads=False)
+
+    def _bwd_host(x, gout, *ps):
+        return _torch_forward(op, hyper, x, tags, ps, need_grads=True,
+                              gout=gout)
+
+    @jax.custom_vjp
+    def f(x, ps):
+        out_sd = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+        return jax.pure_callback(_fwd_host, out_sd, x, *ps)
+
+    def f_fwd(x, ps):
+        return f(x, ps), (x, ps)
+
+    def f_bwd(res, gout):
+        x, ps = res
+        out_sds = (jax.ShapeDtypeStruct(x.shape, jnp.float32),) + tuple(
+            jax.ShapeDtypeStruct(p.shape, jnp.float32) for p in ps)
+        grads = jax.pure_callback(_bwd_host, out_sds, x, gout, *ps)
+        return grads[0], tuple(grads[1:])
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
