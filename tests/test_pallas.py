@@ -1,0 +1,53 @@
+"""Pallas kernel parity tests (interpreter mode on the CPU mesh).
+
+PairTest-style differential check: the Pallas LRN kernel against the plain
+XLA path (``nn.lrn``'s shifted-adds formulation), forward and backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.ops import nn as N
+from cxxnet_tpu.ops.pallas_kernels import lrn_pallas
+
+
+def _xla_lrn(x, nsize, alpha, beta, knorm):
+    salpha = alpha / nsize
+    norm = N.chpool_sum(jnp.square(x), nsize) * salpha + knorm
+    return x * jnp.power(norm, -beta)
+
+
+@pytest.mark.parametrize("nsize,beta", [(5, 0.75), (3, 0.5), (4, 0.75)])
+def test_lrn_pallas_forward(nsize, beta):
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 16, 5, 7),
+                    jnp.float32)
+    got = lrn_pallas(x, nsize, 0.001, beta, 1.0)
+    want = _xla_lrn(x, nsize, 0.001, beta, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("nsize,beta", [(5, 0.75), (3, 0.5), (4, 0.75)])
+def test_lrn_pallas_grad(nsize, beta):
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 16, 4, 5),
+                    jnp.float32)
+    w = jnp.asarray(np.random.RandomState(2).randn(*x.shape), jnp.float32)
+
+    g_pallas = jax.grad(
+        lambda v: (lrn_pallas(v, nsize, 0.001, beta, 1.0) * w).sum())(x)
+    g_xla = jax.grad(
+        lambda v: (_xla_lrn(v, nsize, 0.001, beta, 1.0) * w).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_dispatch_forced_pallas(monkeypatch):
+    """nn.lrn routes through the Pallas kernel when CXXNET_PALLAS_LRN=1."""
+    monkeypatch.setattr(N, "_PALLAS_LRN", "1")
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 3, 3), jnp.float32)
+    got = N.lrn(x, 5, 0.001, 0.75, 1.0)
+    want = _xla_lrn(x, 5, 0.001, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
