@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Shard an image list into N partitions for distributed training.
+
+Reference: ``tools/imgbin-partition-maker.py`` — shuffles a .lst, groups it
+into partitions, and emits a Makefile whose rules pack each partition with
+im2bin (so ``make -j`` packs shards in parallel).  Same capability here,
+updated: partitions can be sized by instance count or by total image bytes,
+packing can run inline (python packer) or via an emitted Makefile driving
+the native ``im2bin`` tool, and the shard naming matches what the imgbin
+iterator's multi-part/``dist_worker_rank`` sharding consumes.
+
+Usage:
+  python tools/partition_maker.py --img_list all.lst --img_root images/ \
+      --out parts/ --prefix train --num_parts 8 [--shuffle 1] [--pack 1]
+  python tools/partition_maker.py ... --makefile Gen.mk --im2bin native/im2bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+
+def read_list(path: str):
+    with open(path) as f:
+        return [ln for ln in f if ln.strip()]
+
+
+def partition(lines, num_parts=0, part_bytes=0, img_root=""):
+    """Split into shards: equal-count round blocks, or greedy by on-disk
+    image size when --part_mb is given."""
+    if part_bytes > 0:
+        parts, cur, cur_sz = [], [], 0
+        for ln in lines:
+            fname = ln.split("\t")[-1].strip()
+            try:
+                sz = os.path.getsize(os.path.join(img_root, fname))
+            except OSError:
+                sz = 0
+            if cur and cur_sz + sz > part_bytes:
+                parts.append(cur)
+                cur, cur_sz = [], 0
+            cur.append(ln)
+            cur_sz += sz
+        if cur:
+            parts.append(cur)
+        return parts
+    assert num_parts > 0, "give --num_parts or --part_mb"
+    base, rem = divmod(len(lines), num_parts)
+    parts, pos = [], 0
+    for i in range(num_parts):
+        n = base + (1 if i < rem else 0)
+        parts.append(lines[pos:pos + n])
+        pos += n
+    return parts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--img_list", required=True)
+    ap.add_argument("--img_root", default="")
+    ap.add_argument("--out", required=True, help="output directory")
+    ap.add_argument("--prefix", required=True, help="shard name prefix")
+    ap.add_argument("--num_parts", type=int, default=0)
+    ap.add_argument("--part_mb", type=int, default=0,
+                    help="target partition size in MB of source images")
+    ap.add_argument("--shuffle", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=888)
+    ap.add_argument("--pack", type=int, default=0,
+                    help="1 = pack each shard to .bin inline (python packer)")
+    ap.add_argument("--makefile", default="",
+                    help="emit a Makefile with one im2bin rule per shard")
+    ap.add_argument("--im2bin", default="native/im2bin")
+    args = ap.parse_args(argv)
+
+    lines = read_list(args.img_list)
+    if args.shuffle:
+        random.Random(args.seed).shuffle(lines)
+    parts = partition(lines, args.num_parts, args.part_mb * (1 << 20),
+                      args.img_root)
+
+    os.makedirs(args.out, exist_ok=True)
+    lst_paths = []
+    for i, part in enumerate(parts):
+        p = os.path.join(args.out, f"{args.prefix}_{i}.lst")
+        with open(p, "w") as f:
+            f.writelines(part)
+        lst_paths.append(p)
+    print(f"wrote {len(parts)} shard lists under {args.out}")
+
+    if args.makefile:
+        bins = [p[:-4] + ".bin" for p in lst_paths]
+        with open(args.makefile, "w") as f:
+            f.write("all: " + " ".join(bins) + "\n\n")
+            for lst, bin_ in zip(lst_paths, bins):
+                f.write(f"{bin_}: {lst}\n"
+                        f"\t{args.im2bin} {lst} {args.img_root} {bin_}\n\n")
+        print(f"emitted {args.makefile}; run: make -f {args.makefile} -j")
+    if args.pack:
+        from cxxnet_tpu.io.imbin import pack_imbin
+        for lst in lst_paths:
+            out = lst[:-4] + ".bin"
+            pack_imbin(lst, args.img_root, out)
+            print(f"packed {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())
