@@ -1,0 +1,82 @@
+/*
+ * C ABI for the TPU-native cxxnet framework.
+ *
+ * Mirrors the reference's handle-based wrapper surface
+ * (wrapper/cxxnet_wrapper.h:29-225: CXNNet* / CXNIO* functions) for C/C++
+ * embedders.  The implementation embeds CPython and dispatches to
+ * cxxnet_tpu.wrapper.api (Net / DataIter); the compute itself runs through
+ * JAX/XLA exactly as in the Python path.
+ *
+ * Conventions:
+ *  - all functions acquire the interpreter lock internally; the library is
+ *    safe to call from one thread at a time.
+ *  - returned pointers (arrays, strings) stay valid until the next call on
+ *    the same handle, matching the reference wrapper's buffer reuse.
+ *  - on error, functions return NULL/-1 and CXNGetLastError() describes it.
+ */
+#ifndef CXXNET_TPU_CAPI_H_
+#define CXXNET_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef float cxx_real_t;
+typedef uint64_t cxx_ulong;
+
+const char *CXNGetLastError(void);
+
+/* ---- net ---- */
+void *CXNNetCreate(const char *device, const char *cfg);
+void CXNNetFree(void *handle);
+int CXNNetSetParam(void *handle, const char *name, const char *val);
+int CXNNetInitModel(void *handle);
+int CXNNetSaveModel(void *handle, const char *fname);
+int CXNNetLoadModel(void *handle, const char *fname);
+int CXNNetCopyModelFrom(void *handle, const char *fname);
+int CXNNetStartRound(void *handle, int round);
+
+/* data/label are dense float32, shapes row-major */
+int CXNNetUpdateBatch(void *handle, const cxx_real_t *data,
+                      const cxx_ulong *dshape, int dndim,
+                      const cxx_real_t *label, const cxx_ulong *lshape,
+                      int lndim);
+int CXNNetUpdateIter(void *handle, void *data_iter);
+
+/* out_shape must hold 4 entries; returns pointer into handle-owned memory */
+const cxx_real_t *CXNNetPredictBatch(void *handle, const cxx_real_t *data,
+                                     const cxx_ulong *dshape, int dndim,
+                                     cxx_ulong *out_shape, int *out_ndim);
+const cxx_real_t *CXNNetPredictIter(void *handle, void *data_iter,
+                                    cxx_ulong *out_shape, int *out_ndim);
+const cxx_real_t *CXNNetExtractBatch(void *handle, const cxx_real_t *data,
+                                     const cxx_ulong *dshape, int dndim,
+                                     const char *node_name,
+                                     cxx_ulong *out_shape, int *out_ndim);
+const cxx_real_t *CXNNetExtractIter(void *handle, void *data_iter,
+                                    const char *node_name,
+                                    cxx_ulong *out_shape, int *out_ndim);
+const char *CXNNetEvaluate(void *handle, void *data_iter, const char *name);
+
+const cxx_real_t *CXNNetGetWeight(void *handle, const char *layer_name,
+                                  const char *tag, cxx_ulong *out_shape,
+                                  int *out_ndim);
+int CXNNetSetWeight(void *handle, const cxx_real_t *weight, cxx_ulong size,
+                    const char *layer_name, const char *tag);
+
+/* ---- data iterators ---- */
+void *CXNIOCreateFromConfig(const char *cfg);
+void CXNIOFree(void *handle);
+int CXNIONext(void *handle); /* 1 = has batch, 0 = end, -1 = error */
+int CXNIOBeforeFirst(void *handle);
+const cxx_real_t *CXNIOGetData(void *handle, cxx_ulong *out_shape,
+                               int *out_ndim);
+const cxx_real_t *CXNIOGetLabel(void *handle, cxx_ulong *out_shape,
+                                int *out_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* CXXNET_TPU_CAPI_H_ */
