@@ -104,8 +104,107 @@ class BatchAdaptIterator(IIterator):
         return b
 
 
+class AffineAugmenter:
+    """Geometric augmentation via one warpAffine per instance (reference
+    ``image_augmenter-inl.hpp:13-204``): random rotation (range or explicit
+    ``rotate_list``), shear, aspect-ratio jitter, and a random square crop
+    of side in [min_crop_size, max_crop_size] resized back to the target
+    shape.  Skipped entirely when no geometric param is set (NeedProcess,
+    :156-161)."""
+
+    def __init__(self):
+        self.rotate = -1.0           # fixed angle; -1 = off
+        self.max_rotate_angle = 0.0
+        self.max_shear_ratio = 0.0
+        self.max_aspect_ratio = 0.0
+        self.min_crop_size = -1
+        self.max_crop_size = -1
+        self.rotate_list: List[float] = []
+        self.fill_value = 0.0
+
+    def set_param(self, name, val) -> bool:
+        if name == "rotate":
+            self.rotate = float(val)
+        elif name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        elif name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        elif name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        elif name == "min_crop_size":
+            self.min_crop_size = int(val)
+        elif name == "max_crop_size":
+            self.max_crop_size = int(val)
+        elif name == "rotate_list":
+            self.rotate_list = [float(t) for t in val.split(",") if t.strip()]
+        elif name == "fill_value":
+            self.fill_value = float(val)
+        else:
+            return False
+        return True
+
+    @property
+    def need_process(self) -> bool:
+        return (self.rotate >= 0 or self.max_rotate_angle > 0
+                or self.max_shear_ratio > 0 or self.max_aspect_ratio > 0
+                or bool(self.rotate_list)
+                or (self.min_crop_size > 0 and self.max_crop_size > 0))
+
+    def process(self, d: np.ndarray, rnd: np.random.RandomState,
+                target_yx) -> np.ndarray:
+        """d is (c, y, x) float32; returns (c, ty, tx) when cropping, else
+        the warped image at its original size."""
+        import cv2
+        img = d.transpose(1, 2, 0)  # HWC for cv
+        h, w = img.shape[:2]
+        if self.rotate >= 0:
+            angle = self.rotate
+        elif self.rotate_list:
+            angle = self.rotate_list[rnd.randint(len(self.rotate_list))]
+        else:
+            a = self.max_rotate_angle
+            angle = rnd.uniform(-a, a) if a > 0 else 0.0
+        shear = rnd.uniform(-self.max_shear_ratio, self.max_shear_ratio) \
+            if self.max_shear_ratio > 0 else 0.0
+        if self.max_aspect_ratio > 0:
+            ratio = 1.0 + rnd.uniform(0, self.max_aspect_ratio)
+            if rnd.rand() < 0.5:
+                ratio = 1.0 / ratio
+            sx, sy = np.sqrt(ratio), 1.0 / np.sqrt(ratio)
+        else:
+            sx = sy = 1.0
+        if angle != 0.0 or shear != 0.0 or sx != 1.0:
+            rad = np.deg2rad(angle)
+            cos, sin = np.cos(rad), np.sin(rad)
+            # rotation @ shear @ aspect-scale, centered on the image
+            lin = np.array([[cos, -sin], [sin, cos]], np.float64) \
+                @ np.array([[1.0, shear], [0.0, 1.0]], np.float64) \
+                @ np.diag([sx, sy])
+            c = np.array([(w - 1) / 2.0, (h - 1) / 2.0])
+            m = np.hstack([lin, (c - lin @ c).reshape(2, 1)])
+            img = cv2.warpAffine(
+                img, m, (w, h), flags=cv2.INTER_LINEAR,
+                borderMode=cv2.BORDER_CONSTANT,
+                borderValue=[self.fill_value] * img.shape[2])
+        if self.min_crop_size > 0 and self.max_crop_size > 0:
+            assert self.min_crop_size <= min(h, w), \
+                (f"augment: min_crop_size={self.min_crop_size} exceeds "
+                 f"image size {h}x{w}")
+            cs = rnd.randint(self.min_crop_size,
+                             min(self.max_crop_size, h, w) + 1)
+            y0 = rnd.randint(0, max(h - cs, 0) + 1)
+            x0 = rnd.randint(0, max(w - cs, 0) + 1)
+            patch = img[y0:y0 + cs, x0:x0 + cs]
+            ty, tx = target_yx
+            img = cv2.resize(patch, (tx, ty), interpolation=cv2.INTER_LINEAR)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return np.ascontiguousarray(img.transpose(2, 0, 1), np.float32)
+
+
 class AugmentIterator(IIterator):
     """Per-instance augmentation (iter_augment_proc-inl.hpp:21-246):
+    cv-affine stage (rotation/shear/aspect/crop-size, see AffineAugmenter),
     random/fixed crop, mirror, mean subtraction (mean image file generated on
     first use, :171-198, or mean_value RGB), scale."""
 
@@ -122,11 +221,14 @@ class AugmentIterator(IIterator):
         self.max_random_illumination = 0.0
         self.crop_y_start = -1
         self.crop_x_start = -1
+        self.affine = AffineAugmenter()
         self.rnd = np.random.RandomState(_AUG_RAND_MAGIC)
         self._mean: Optional[np.ndarray] = None
 
     def set_param(self, name, val):
-        if name == "rand_crop":
+        if self.affine.set_param(name, val):
+            pass
+        elif name == "rand_crop":
             self.rand_crop = int(val)
         elif name == "rand_mirror":
             self.rand_mirror = int(val)
@@ -186,8 +288,21 @@ class AugmentIterator(IIterator):
         if inst is None:
             return None
         d = inst.data.astype(np.float32)
-        if self._mean is not None and self._mean.shape == d.shape:
-            d = d - self._mean
+        if self.affine.need_process:
+            target = self.input_shape[1:] if self.input_shape is not None \
+                else d.shape[1:]
+            d = self.affine.process(d, self.rnd, target)
+        if self._mean is not None:
+            m = self._mean
+            if m.shape != d.shape:
+                my, mx = m.shape[1], m.shape[2]
+                dy, dx = d.shape[1], d.shape[2]
+                if my >= dy and mx >= dx:
+                    y0, x0 = (my - dy) // 2, (mx - dx) // 2
+                    m = m[:, y0:y0 + dy, x0:x0 + dx]
+                else:  # affine resized past the mean image: channel means
+                    m = m.mean(axis=(1, 2), keepdims=True)
+            d = d - m
         elif self.mean_value is not None:
             d = d - self.mean_value.reshape(-1, 1, 1)
         if self.max_random_contrast > 0:

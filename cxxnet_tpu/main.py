@@ -268,6 +268,25 @@ class LearnTask:
                     fo.write(f"{v:g}\n")
         print(f"finished prediction, write into {self.name_pred}")
 
+    def task_predict_raw(self) -> None:
+        """task=pred_raw: write full output rows (e.g. softmax probabilities)
+        space-separated, one instance per line (reference
+        cxxnet_main.cpp TaskPredictRaw)."""
+        assert self.itr_pred is not None, \
+            "must specify a pred iterator to generate predictions"
+        print("start predicting raw scores...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while True:
+                batch = self.itr_pred.next()
+                if batch is None:
+                    break
+                out = self.net.predict_raw(batch)
+                out = out[:batch.batch_size - batch.num_batch_padd]
+                for row in out:
+                    fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+        print(f"finished prediction, write into {self.name_pred}")
+
     def task_extract(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a pred iterator for feature extraction"
@@ -305,6 +324,8 @@ class LearnTask:
             self.task_train()
         elif self.task == "pred":
             self.task_predict()
+        elif self.task == "pred_raw":
+            self.task_predict_raw()
         elif self.task == "extract":
             self.task_extract()
         else:

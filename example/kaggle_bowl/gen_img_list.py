@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Build an image list (index \t label \t path) for the bowl dataset.
+
+* train: class subfolders under train_folder; class ids follow the column
+  order of sampleSubmission.csv (so the submission lines up).
+* test: flat folder, label 0.
+
+Usage: gen_img_list.py train|test sampleSubmission.csv image_folder out.lst
+"""
+
+import csv
+import os
+import random
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 5:
+        print("Usage: gen_img_list.py train|test sample_submission.csv "
+              "image_folder out.lst")
+        return 1
+    task, sub_csv, folder, out = sys.argv[1:5]
+    random.seed(888)
+    with open(sub_csv, newline="") as f:
+        classes = next(csv.reader(f))[1:]  # header minus the image column
+
+    rows = []
+    if task == "train":
+        for cid, cls in enumerate(classes):
+            d = os.path.join(folder, cls)
+            for img in sorted(os.listdir(d)):
+                rows.append((cid, os.path.join(folder, cls, img)))
+        random.shuffle(rows)
+    else:
+        for img in sorted(os.listdir(folder)):
+            rows.append((0, os.path.join(folder, img)))
+
+    with open(out, "w") as fo:
+        for i, (label, path) in enumerate(rows):
+            fo.write(f"{i}\t{label}\t{path}\n")
+    print(f"wrote {len(rows)} entries to {out} ({len(classes)} classes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

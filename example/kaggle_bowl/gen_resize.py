@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Resize every image under input_folder to 48x48 into output_folder,
+preserving one level of class subdirectories (reference gen_train.py /
+gen_test.py used ImageMagick; we use cv2)."""
+
+import os
+import sys
+
+import cv2
+
+SIZE = 48
+
+
+def main() -> int:
+    if len(sys.argv) < 3:
+        print("Usage: gen_resize.py input_folder output_folder")
+        return 1
+    src, dst = sys.argv[1], sys.argv[2]
+    os.makedirs(dst, exist_ok=True)
+    n = 0
+    for root, _, files in os.walk(src):
+        rel = os.path.relpath(root, src)
+        outdir = os.path.join(dst, rel) if rel != "." else dst
+        os.makedirs(outdir, exist_ok=True)
+        for f in files:
+            img = cv2.imread(os.path.join(root, f))
+            if img is None:
+                continue
+            img = cv2.resize(img, (SIZE, SIZE),
+                             interpolation=cv2.INTER_LINEAR)
+            cv2.imwrite(os.path.join(outdir, os.path.splitext(f)[0] + ".jpg"),
+                        img)
+            n += 1
+    print(f"resized {n} images into {dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+#!/bin/sh
+# split a shuffled train.lst into training and validation lists
+head -n 20000 "$1" > tr.lst
+tail -n +20001 "$1" > va.lst
+wc -l tr.lst va.lst

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Merge test.lst + the pred_raw output into a Kaggle submission csv.
+
+Usage: make_submission.py sampleSubmission.csv test.lst test.txt out.csv
+"""
+
+import csv
+import os
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 5:
+        print("Usage: make_submission.py sample_submission.csv test.lst "
+              "test.txt out.csv")
+        return 1
+    sub_csv, lst, scores, out = sys.argv[1:5]
+    with open(sub_csv, newline="") as f:
+        header = next(csv.reader(f))
+
+    names = []
+    with open(lst) as f:
+        for line in f:
+            path = line.rstrip("\n").split("\t")[-1]
+            names.append(os.path.basename(path))
+
+    with open(scores) as f:
+        score_lines = f.read().splitlines()
+    assert len(score_lines) == len(names), \
+        f"{len(score_lines)} score rows vs {len(names)} listed images"
+    with open(out, "w", newline="") as fo:
+        w = csv.writer(fo)
+        w.writerow(header)
+        for name, line in zip(names, score_lines):
+            probs = line.split()
+            assert len(probs) == len(header) - 1, \
+                f"{len(probs)} scores vs {len(header) - 1} classes"
+            w.writerow([name] + probs)
+    print(f"wrote submission {out} ({len(names)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

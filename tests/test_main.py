@@ -1,0 +1,224 @@
+"""End-to-end CLI task tests (LearnTask) and the cv-affine augmenter.
+
+Reference behaviors: task driver ``src/cxxnet_main.cpp`` (train/pred_raw),
+affine augmentation ``src/io/image_augmenter-inl.hpp``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataInst
+from cxxnet_tpu.io.iter_proc import AffineAugmenter
+from cxxnet_tpu.main import LearnTask
+
+
+# --------------------------------------------------------------- affine aug
+
+def _inst(shape=(3, 12, 12)):
+    rnd = np.random.RandomState(0)
+    return rnd.rand(*shape).astype(np.float32)
+
+
+def test_affine_noop_when_params_off():
+    a = AffineAugmenter()
+    assert not a.need_process
+
+
+def test_affine_rotation_shape_and_determinism():
+    a = AffineAugmenter()
+    assert a.set_param("max_rotate_angle", "180")
+    assert a.need_process
+    d = _inst()
+    o1 = a.process(d, np.random.RandomState(7), (12, 12))
+    o2 = a.process(d, np.random.RandomState(7), (12, 12))
+    assert o1.shape == (3, 12, 12)
+    np.testing.assert_array_equal(o1, o2)
+    # a different seed draws a different angle
+    o3 = a.process(d, np.random.RandomState(8), (12, 12))
+    assert np.abs(o1 - o3).max() > 1e-3
+
+
+def test_affine_rotate_180_flips_both_axes():
+    a = AffineAugmenter()
+    a.set_param("rotate", "180")
+    d = _inst((1, 9, 9))  # odd size: exact center, no interpolation drift
+    out = a.process(d, np.random.RandomState(0), (9, 9))
+    np.testing.assert_allclose(out[0], d[0, ::-1, ::-1], atol=1e-4)
+
+
+def test_affine_rotate_list_and_crop_resize():
+    a = AffineAugmenter()
+    a.set_param("rotate_list", "0,90,180,270")
+    a.set_param("min_crop_size", "8")
+    a.set_param("max_crop_size", "12")
+    out = a.process(_inst(), np.random.RandomState(3), (10, 10))
+    assert out.shape == (3, 10, 10)
+    assert out.dtype == np.float32
+
+
+def test_affine_shear_aspect_changes_image():
+    a = AffineAugmenter()
+    a.set_param("max_shear_ratio", "0.3")
+    a.set_param("max_aspect_ratio", "0.5")
+    d = _inst()
+    out = a.process(d, np.random.RandomState(1), (12, 12))
+    assert out.shape == d.shape
+    assert np.abs(out - d).max() > 1e-3
+
+
+def test_augment_iterator_applies_affine_and_mean_crop(tmp_path):
+    """Mean image built at base size must still subtract after the affine
+    stage resizes instances to input_shape (center-crop of the mean)."""
+    from cxxnet_tpu.io.iter_proc import AugmentIterator
+
+    class _Base:
+        def __init__(self):
+            self.d = np.ones((3, 12, 12), np.float32)
+            self.i = 0
+
+        def set_param(self, n, v):
+            pass
+
+        def init(self):
+            pass
+
+        def before_first(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 4:
+                return None
+            self.i += 1
+            return DataInst(label=np.zeros(1, np.float32), data=self.d,
+                            index=self.i)
+
+    it = AugmentIterator(_Base())
+    it.set_param("min_crop_size", "8")
+    it.set_param("max_crop_size", "12")
+    it.set_param("input_shape", "3,8,8")
+    it.set_param("image_mean", str(tmp_path / "mean.npz"))
+    it.init()  # builds the mean (all ones)
+    it.before_first()
+    inst = it.next()
+    assert inst.data.shape == (3, 8, 8)
+    # ones minus mean-of-ones == 0 everywhere, regardless of the crop drawn
+    np.testing.assert_allclose(inst.data, 0.0, atol=1e-5)
+
+
+# ------------------------------------------------------------ CLI end-to-end
+
+MLP_NET = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig=end
+"""
+
+
+def _write_synth_mnist(tmp_path, n=64, classes=4, side=12):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import make_synth_mnist as sm
+    rnd = np.random.RandomState(0)
+    labels = rnd.randint(0, classes, n)
+    imgs = np.stack([
+        np.clip(sm.class_pattern(l, side, side) * 255
+                + rnd.rand(side, side) * 32, 0, 255)
+        for l in labels])
+    sm.write_idx_images(str(tmp_path / "img.gz"), imgs)
+    sm.write_idx_labels(str(tmp_path / "lbl.gz"), labels)
+
+
+@pytest.fixture
+def mnist_conf(tmp_path):
+    _write_synth_mnist(tmp_path, n=128)
+    conf = tmp_path / "train.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 1
+iter = end
+eval = val
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+eta = 0.05
+num_round = 12
+metric = error
+model_dir = {tmp_path}/models
+save_model = 4
+silent = 1
+""")
+    return conf, tmp_path
+
+
+def test_cli_train_then_pred_raw(mnist_conf, capsys):
+    conf, tmp_path = mnist_conf
+    assert LearnTask().run([str(conf)]) == 0
+    model = tmp_path / "models" / "0012.model"
+    assert model.exists()
+
+    pred_conf = tmp_path / "pred.conf"
+    pred_conf.write_text(f"""
+dev = cpu
+task = pred_raw
+model_in = {model}
+pred = {tmp_path}/scores.txt
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+silent = 1
+""")
+    assert LearnTask().run([str(pred_conf)]) == 0
+    rows = np.loadtxt(tmp_path / "scores.txt")
+    assert rows.shape == (128, 4)
+    np.testing.assert_allclose(rows.sum(axis=1), 1.0, atol=1e-3)
+
+    # the trained model should mostly predict the true classes
+    import gzip
+    with gzip.open(tmp_path / "lbl.gz", "rb") as f:
+        f.read(8)
+        labels = np.frombuffer(f.read(), np.uint8)
+    acc = (rows.argmax(axis=1) == labels).mean()
+    assert acc > 0.8, f"pred_raw accuracy {acc}"
+
+
+def test_cli_pred_argmax(mnist_conf):
+    conf, tmp_path = mnist_conf
+    assert LearnTask().run([str(conf), "num_round=4"]) == 0
+    pred_conf = tmp_path / "predc.conf"
+    pred_conf.write_text(f"""
+dev = cpu
+task = pred
+model_in = {tmp_path}/models/0004.model
+pred = {tmp_path}/cls.txt
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+silent = 1
+""")
+    assert LearnTask().run([str(pred_conf)]) == 0
+    cls = np.loadtxt(tmp_path / "cls.txt")
+    assert cls.shape == (128,)
+    assert set(np.unique(cls)) <= {0.0, 1.0, 2.0, 3.0}
