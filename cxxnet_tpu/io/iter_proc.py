@@ -187,9 +187,9 @@ class AffineAugmenter:
                 borderMode=cv2.BORDER_CONSTANT,
                 borderValue=[self.fill_value] * img.shape[2])
         if self.min_crop_size > 0 and self.max_crop_size > 0:
-            assert self.min_crop_size <= min(h, w), \
+            assert self.min_crop_size <= min(self.max_crop_size, h, w), \
                 (f"augment: min_crop_size={self.min_crop_size} exceeds "
-                 f"image size {h}x{w}")
+                 f"max_crop_size={self.max_crop_size} or image size {h}x{w}")
             cs = rnd.randint(self.min_crop_size,
                              min(self.max_crop_size, h, w) + 1)
             y0 = rnd.randint(0, max(h - cs, 0) + 1)

@@ -4,7 +4,7 @@ Reference: ``src/cxxnet_main.cpp`` (CXXNetLearnTask).  Usage parity:
 
     python -m cxxnet_tpu <config.conf> [key=value ...]
 
-Tasks: ``task = train | finetune | pred | extract``; model snapshots
+Tasks: ``task = train | finetune | pred | pred_raw | extract``; snapshots
 ``model_dir/%04d.model`` every ``save_model`` rounds; ``continue = 1``
 resumes from the newest snapshot (SyncLastestModel, cxxnet_main.cpp:135-157);
 ``test_io = 1`` runs the loop without Update (I/O benchmark mode, :363-389).
@@ -282,7 +282,6 @@ class LearnTask:
                 if batch is None:
                     break
                 out = self.net.predict_raw(batch)
-                out = out[:batch.batch_size - batch.num_batch_padd]
                 for row in out:
                     fo.write(" ".join(f"{v:g}" for v in row) + "\n")
         print(f"finished prediction, write into {self.name_pred}")

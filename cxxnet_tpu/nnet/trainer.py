@@ -490,8 +490,6 @@ class NetTrainer:
         """Class predictions (argmax if multi-class) for one batch
         (reference TransformPred, nnet_impl-inl.hpp:286-299)."""
         raw = self.predict_raw(batch)
-        n_valid = batch.batch_size - batch.num_batch_padd
-        raw = raw[:n_valid]
         if raw.shape[1] > 1:
             return raw.argmax(axis=1).astype(np.float32)
         return raw[:, 0]
@@ -502,7 +500,8 @@ class NetTrainer:
         outs = estep(self.params, self.buffers,
                      self._device_batch(batch.data),
                      tuple(self._device_batch(e) for e in batch.extra_data))
-        return np.asarray(outs[nid])
+        n_valid = batch.batch_size - batch.num_batch_padd
+        return np.asarray(outs[nid])[:n_valid]
 
     def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
         nid = self.net.node_id(node_name)

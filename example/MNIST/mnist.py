@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Python-wrapper version of the MNIST example (reference
+example/MNIST/mnist.py used the ctypes wrapper; this uses
+cxxnet_tpu.wrapper).  Run ./run.sh first to create ./data."""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+from cxxnet_tpu.wrapper import DataIter, Net, train  # noqa: E402
+
+CFG = """
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1] = sigmoid
+layer[+1] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+"""
+
+ITER = """
+iter = mnist
+  path_img = ./data/train-images-idx3-ubyte.gz
+  path_label = ./data/train-labels-idx1-ubyte.gz
+  shuffle = 1
+  batch_size = 100
+iter = end
+"""
+
+EVAL_ITER = ITER.replace("train-images-idx3", "t10k-images-idx3") \
+                .replace("train-labels-idx1", "t10k-labels-idx1") \
+                .replace("  shuffle = 1\n", "")
+
+
+def main() -> None:
+    dev = sys.argv[1] if len(sys.argv) > 1 else "cpu"
+    data = DataIter(ITER)
+    eval_data = DataIter(EVAL_ITER)
+    net = train(CFG, data, num_round=10,
+                param={"eta": "0.1", "momentum": "0.9", "wd": "0.0",
+                       "batch_size": "100", "metric": "error"},
+                eval_data=eval_data, dev=dev)
+    net.save_model("./models/final.model")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+#!/bin/sh
+# Usage: ./run.sh [MNIST.conf|MNIST_CONV.conf|LeNet.conf] [key=value ...]
+# Fetches MNIST if possible; falls back to the synthetic generator in
+# zero-egress environments (same idx format, trains the same configs).
+set -e
+conf=${1:-MNIST.conf}
+shift 2>/dev/null || true
+
+if [ ! -f data/train-images-idx3-ubyte.gz ]; then
+    mkdir -p data
+    base=https://ossci-datasets.s3.amazonaws.com/mnist
+    if command -v wget >/dev/null && \
+       wget -q --timeout=10 "$base/train-images-idx3-ubyte.gz" -O \
+           data/train-images-idx3-ubyte.gz 2>/dev/null; then
+        for f in train-labels-idx1-ubyte t10k-images-idx3-ubyte \
+                 t10k-labels-idx1-ubyte; do
+            wget -q "$base/$f.gz" -O "data/$f.gz"
+        done
+        echo "downloaded MNIST"
+    else
+        echo "download unavailable; generating synthetic MNIST-format data"
+        python ../../tools/make_synth_mnist.py --out ./data \
+            --train 2000 --test 500
+    fi
+fi
+
+mkdir -p models
+PYTHONPATH=../..:$PYTHONPATH python -m cxxnet_tpu "$conf" model_dir=models "$@"

@@ -1,5 +1,13 @@
 #!/bin/sh
-# split a shuffled train.lst into training and validation lists
-head -n 20000 "$1" > tr.lst
-tail -n +20001 "$1" > va.lst
+# split a shuffled train.lst into training and validation lists (last ~1/6
+# held out for validation)
+set -e
+total=$(wc -l < "$1")
+ntr=$(( total * 5 / 6 ))
+if [ "$ntr" -lt 1 ] || [ "$ntr" -ge "$total" ]; then
+    echo "gen_tr_va.sh: $1 has only $total lines, cannot split" >&2
+    exit 1
+fi
+head -n "$ntr" "$1" > tr.lst
+tail -n +"$(( ntr + 1 ))" "$1" > va.lst
 wc -l tr.lst va.lst
