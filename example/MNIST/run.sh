@@ -6,22 +6,30 @@ set -e
 conf=${1:-MNIST.conf}
 shift 2>/dev/null || true
 
-if [ ! -f data/train-images-idx3-ubyte.gz ]; then
-    mkdir -p data
+have_all() {
+    for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+             t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+        [ -f "data/$f.gz" ] || return 1
+    done
+}
+
+if ! have_all; then
     base=https://ossci-datasets.s3.amazonaws.com/mnist
+    tmp=$(mktemp -d)
     if command -v wget >/dev/null && \
-       wget -q --timeout=10 "$base/train-images-idx3-ubyte.gz" -O \
-           data/train-images-idx3-ubyte.gz 2>/dev/null; then
-        for f in train-labels-idx1-ubyte t10k-images-idx3-ubyte \
-                 t10k-labels-idx1-ubyte; do
-            wget -q "$base/$f.gz" -O "data/$f.gz"
-        done
+       for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+                t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+           wget -q --timeout=10 --tries=1 "$base/$f.gz" \
+               -O "$tmp/$f.gz" || exit 1
+       done; then
+        mkdir -p data && mv "$tmp"/*.gz data/
         echo "downloaded MNIST"
     else
         echo "download unavailable; generating synthetic MNIST-format data"
         python ../../tools/make_synth_mnist.py --out ./data \
             --train 2000 --test 500
     fi
+    rm -rf "$tmp"
 fi
 
 mkdir -p models
