@@ -48,7 +48,7 @@ def main() -> None:
     import jax
     from __graft_entry__ import ALEXNET_NET, _make_trainer
 
-    batch = 512
+    batch = 1024  # measured +3% imgs/sec over 512 on v5e
     scan_len = 10
     trials = 3
     t = _make_trainer(ALEXNET_NET, batch, "tpu",

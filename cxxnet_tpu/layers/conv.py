@@ -31,6 +31,15 @@ class ConvolutionLayer(Layer):
 
     type_names = ("conv",)
 
+    def __init__(self):
+        super().__init__()
+        self.space_to_depth = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "space_to_depth":
+            self.space_to_depth = int(val)
+        super().set_param(name, val)
+
     def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
         assert len(in_shapes) == 1, "conv: 1-1 connection only"
         p = self.param
@@ -64,8 +73,12 @@ class ConvolutionLayer(Layer):
         self.check_n_inputs(inputs, 1)
         p = self.param
         x = inputs[0]
-        out = N.conv2d(x, params["wmat"], stride=p.stride,
-                       pad_y=p.pad_y, pad_x=p.pad_x, num_group=p.num_group)
+        if self.space_to_depth and p.stride > 1 and p.num_group == 1:
+            out = N.conv2d_s2d(x, params["wmat"], stride=p.stride,
+                               pad_y=p.pad_y, pad_x=p.pad_x)
+        else:
+            out = N.conv2d(x, params["wmat"], stride=p.stride,
+                           pad_y=p.pad_y, pad_x=p.pad_x, num_group=p.num_group)
         if "bias" in params:
             out = out + params["bias"].astype(out.dtype).reshape(1, -1, 1, 1)
         return [out], buffers

@@ -38,6 +38,7 @@ class LearnTask:
         self.silent = 0
         self.test_io = 0
         self.extract_node_name = ""
+        self.prof_dir = ""
         self.name_pred = "pred.txt"
         self.output_format = 1
         # default 1, reference nnet_impl-inl.hpp:22; gates both metric
@@ -82,6 +83,8 @@ class LearnTask:
             self.extract_node_name = val
         elif name == "eval_train":
             self.eval_train = int(val)
+        elif name == "prof":
+            self.prof_dir = val
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -220,11 +223,24 @@ class LearnTask:
         if self.test_io:
             print("start I/O test")
         cc = self.max_round
+        rounds_done = 0
+        tracing = False
+        # profile the second round (past compilation) — or the only round
+        # when just one will run
+        will_run = min(self.num_round - self.start_counter + 1,
+                       self.max_round)
+        prof_round = 1 if will_run > 1 else 0
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:
                 print(f"update round {self.start_counter - 1}", flush=True)
+            if self.prof_dir and rounds_done == prof_round:
+                import jax
+                jax.profiler.start_trace(self.prof_dir)
+                tracing = True
             sample_counter = 0
+            t_mark = time.time()
+            n_mark = 0
             self.net.start_round(self.start_counter)
             self.itr_train.before_first()
             while True:
@@ -234,11 +250,21 @@ class LearnTask:
                 if self.test_io == 0:
                     self.net.update(batch)
                 sample_counter += 1
+                n_mark += batch.batch_size - batch.num_batch_padd
                 if sample_counter % self.print_step == 0 and not self.silent:
-                    elapsed = int(time.time() - start)
+                    now = time.time()
+                    rate = n_mark / max(now - t_mark, 1e-9)
+                    t_mark, n_mark = now, 0
                     print(f"round {self.start_counter - 1:8d}:"
-                          f"[{sample_counter:8d}] {elapsed} sec elapsed",
-                          flush=True)
+                          f"[{sample_counter:8d}] {int(now - start)} sec "
+                          f"elapsed, {rate:.1f} examples/sec", flush=True)
+            if tracing:
+                import jax
+                jax.profiler.stop_trace()
+                tracing = False
+                if not self.silent:
+                    print(f"profile trace written to {self.prof_dir}")
+            rounds_done += 1
             if self.test_io == 0:
                 line = f"[{self.start_counter}]"
                 # only print the train metric when the trainer actually

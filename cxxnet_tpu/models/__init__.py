@@ -9,6 +9,6 @@ language, so everything downstream (NetConfig, trainer, checkpointing,
 wrapper) treats zoo models identically to user-written config files.
 """
 
-from .zoo import alexnet, googlenet, lenet, mlp, transformer
+from .zoo import alexnet, googlenet, lenet, mlp, resnet, transformer
 
-__all__ = ["alexnet", "googlenet", "lenet", "mlp", "transformer"]
+__all__ = ["alexnet", "googlenet", "lenet", "mlp", "resnet", "transformer"]

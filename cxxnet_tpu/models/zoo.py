@@ -264,3 +264,66 @@ def transformer(vocab: int, seq: int, dim: int, nlayer: int,
               f"input_shape = 1,1,{seq}",
               f"label_vec[0,{seq}) = label"]
     return "\n".join(lines) + "\n"
+
+
+def _res_block(lines: List[str], name: str, bottom: str, w: int,
+               stride: int, project: bool) -> str:
+    """Basic residual block: two 3x3 conv+bn with an identity (or 1x1
+    projected) shortcut summed by eltsum.  Fan-out goes through an explicit
+    split layer, same idiom as the transformer blocks above."""
+    lines += [f"layer[{bottom}->{name}_sc,{name}_in] = split",
+              f"layer[{name}_in->{name}_c1] = conv:{name}_conv1",
+              "  kernel_size = 3", "  pad = 1",
+              f"  stride = {stride}", f"  nchannel = {w}", "  no_bias = 1",
+              f"layer[{name}_c1->{name}_c1] = batch_norm:{name}_bn1",
+              f"layer[{name}_c1->{name}_c1] = relu",
+              f"layer[{name}_c1->{name}_c2] = conv:{name}_conv2",
+              "  kernel_size = 3", "  pad = 1",
+              f"  nchannel = {w}", "  no_bias = 1",
+              f"layer[{name}_c2->{name}_c2] = batch_norm:{name}_bn2"]
+    sc = f"{name}_sc"
+    if project:
+        lines += [f"layer[{sc}->{name}_p] = conv:{name}_proj",
+                  "  kernel_size = 1",
+                  f"  stride = {stride}", f"  nchannel = {w}", "  no_bias = 1",
+                  f"layer[{name}_p->{name}_p] = batch_norm:{name}_bnp"]
+        sc = f"{name}_p"
+    lines += [f"layer[{sc},{name}_c2->{name}] = eltsum",
+              f"layer[{name}->{name}] = relu"]
+    return name
+
+
+def resnet(num_class: int = 10, depth: int = 20,
+           widths=(16, 32, 64), input_side: int = 32) -> str:
+    """CIFAR-style ResNet (depth = 6n+2): three stages of basic blocks with
+    widths 16/32/64, global average pooling, softmax head.
+
+    No reference counterpart (the reference predates residual nets); the
+    layer zoo's split/eltsum/batch_norm make it expressible, so this
+    builder exists to exercise that family end-to-end.
+    """
+    assert (depth - 2) % 6 == 0, "resnet: depth must be 6n+2"
+    n = (depth - 2) // 6
+    lines = ["netconfig=start",
+             "layer[0->stem] = conv:stem",
+             "  kernel_size = 3", "  pad = 1",
+             f"  nchannel = {widths[0]}", "  no_bias = 1",
+             "layer[stem->stem] = batch_norm:stem_bn",
+             "layer[stem->stem] = relu"]
+    top = "stem"
+    side = input_side
+    for si, w in enumerate(widths):
+        for bi in range(n):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            side //= stride
+            top = _res_block(lines, f"s{si}b{bi}", top, w,
+                             stride, project=stride != 1)
+    lines += [f"layer[{top}->gp] = avg_pooling",
+              f"  kernel_size = {side}", f"  stride = {side}",
+              "layer[gp->fl] = flatten",
+              "layer[fl->fc] = fullc:fc",
+              f"  nhidden = {num_class}",
+              "layer[fc->fc] = softmax",
+              "netconfig=end",
+              f"input_shape = 3,{input_side},{input_side}"]
+    return "\n".join(lines) + "\n"

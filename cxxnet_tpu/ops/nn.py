@@ -66,6 +66,46 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
     )
 
 
+def conv2d_s2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int,
+               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    """Space-to-depth convolution: rearrange stride-s spatial blocks into
+    channels and run the equivalent stride-1 conv.
+
+    Numerically identical to ``conv2d`` (same contraction, reordered), but
+    maps far better onto the MXU for the AlexNet-conv1 shape class (large
+    kernel, large stride, few input channels), where the strided access
+    pattern and tiny channel dim starve the systolic array.  No reference
+    counterpart — this is a TPU-specific lowering choice behind the same
+    layer math.
+    """
+    s = stride
+    n, c, h, w_in = x.shape
+    co, ci, kh, kw = w.shape
+    assert ci == c, "conv2d_s2d: grouped conv not supported"
+    oh = conv_out_size(h, kh, s, pad_y)
+    ow = conv_out_size(w_in, kw, s, pad_x)
+    kb_y = -(-kh // s)  # ceil
+    kb_x = -(-kw // s)
+    hb, wb = oh - 1 + kb_y, ow - 1 + kb_x
+    # pad: requested conv padding, then up to whole blocks; a strided conv
+    # may also leave unconsumed tail rows/cols (floor in conv_out_size), so
+    # clamp the trailing pad at 0 and slice the block grid to size
+    xp = jnp.pad(x, ((0, 0), (0, 0),
+                     (pad_y, max(0, hb * s - h - pad_y)),
+                     (pad_x, max(0, wb * s - w_in - pad_x))))
+    xp = xp[:, :, :hb * s, :wb * s]
+    xb = xp.reshape(n, c, hb, s, wb, s)
+    xb = xb.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * s * s, hb, wb)
+    wp = jnp.pad(w, ((0, 0), (0, 0),
+                     (0, kb_y * s - kh), (0, kb_x * s - kw)))
+    wb_ = wp.reshape(co, ci, kb_y, s, kb_x, s)
+    wb_ = wb_.transpose(0, 1, 3, 5, 2, 4).reshape(co, ci * s * s, kb_y, kb_x)
+    return lax.conv_general_dilated(
+        xb, wb_.astype(xb.dtype), window_strides=(1, 1),
+        padding=((0, 0), (0, 0)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
 def pool_out_size_padded(in_size: int, ksize: int, stride: int,
                          pad: int) -> int:
     """Pool output size with symmetric leading padding (a superset of the

@@ -13,15 +13,19 @@ have_all() {
     done
 }
 
-if ! have_all; then
+fetch_all() {
+    command -v wget >/dev/null || return 1
     base=https://ossci-datasets.s3.amazonaws.com/mnist
+    for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+             t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+        wget -q --timeout=10 --tries=1 "$base/$f.gz" \
+            -O "$tmp/$f.gz" || return 1
+    done
+}
+
+if ! have_all; then
     tmp=$(mktemp -d)
-    if command -v wget >/dev/null && \
-       for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
-                t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
-           wget -q --timeout=10 --tries=1 "$base/$f.gz" \
-               -O "$tmp/$f.gz" || exit 1
-       done; then
+    if fetch_all; then
         mkdir -p data && mv "$tmp"/*.gz data/
         echo "downloaded MNIST"
     else

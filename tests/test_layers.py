@@ -380,3 +380,51 @@ def test_pairtest_detects_divergence():
     outs, _ = layer.forward({}, {}, [jnp.asarray(x)], ctx)
     (key,) = [k for k in ctx.diagnostics if k.endswith("fwd_rel_err")]
     assert float(ctx.diagnostics[key]) > 1e-3
+
+
+def test_conv2d_s2d_matches_conv2d():
+    """Space-to-depth lowering is numerically the same conv (fwd + grads)."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops import nn as N
+    rnd = np.random.RandomState(0)
+    for (n, c, h, w, co, k, s, p) in [(2, 3, 23, 23, 8, 11, 4, 0),
+                                      (2, 3, 16, 16, 4, 5, 2, 2),
+                                      (1, 4, 15, 15, 4, 7, 3, 1)]:
+        x = jnp.asarray(rnd.rand(n, c, h, w).astype(np.float32))
+        wt = jnp.asarray((rnd.rand(co, c, k, k) - 0.5).astype(np.float32))
+        a = N.conv2d(x, wt, stride=s, pad_y=p, pad_x=p)
+        b = N.conv2d_s2d(x, wt, stride=s, pad_y=p, pad_x=p)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+        ga = jax.grad(lambda xx, ww: jnp.sum(
+            N.conv2d(xx, ww, stride=s, pad_y=p, pad_x=p) ** 2),
+            argnums=(0, 1))(x, wt)
+        gb = jax.grad(lambda xx, ww: jnp.sum(
+            N.conv2d_s2d(xx, ww, stride=s, pad_y=p, pad_x=p) ** 2),
+            argnums=(0, 1))(x, wt)
+        for u, v in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                       rtol=1e-3, atol=1e-3)
+
+
+def test_conv_layer_space_to_depth_key():
+    """conv layer with space_to_depth=1 produces the same outputs."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.layers.base import ForwardContext
+    from cxxnet_tpu.layers.registry import create_layer
+    rnd = np.random.RandomState(1)
+    x = jnp.asarray(rnd.rand(2, 3, 23, 23).astype(np.float32))
+    outs = []
+    for flag in ("0", "1"):
+        l = create_layer("conv")
+        l.set_param("kernel_size", "11")
+        l.set_param("stride", "4")
+        l.set_param("nchannel", "8")
+        l.set_param("space_to_depth", flag)
+        params = l.init_params(jax.random.PRNGKey(0), [(2, 3, 23, 23)])
+        assert l.infer_shapes([(2, 3, 23, 23)]) == [(2, 8, 4, 4)]
+        (out,), _ = l.forward(params, {}, [x], ForwardContext(train=True))
+        outs.append(np.asarray(out))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-4, atol=1e-4)

@@ -175,3 +175,37 @@ iter = threadbuffer
     while it2.next():
         n += 1
     assert n == 2
+
+
+def test_resnet_builder_shapes():
+    from cxxnet_tpu.models import resnet
+    from cxxnet_tpu.nnet.netconfig import NetConfig
+    from cxxnet_tpu.utils.config import parse_config_string
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(resnet(num_class=10, depth=20)))
+    # depth 20 = 3 stages x 3 blocks x 2 convs + stem + head fullc
+    conv_names = [l.type_name for l in cfg.layers if l.type_name == "conv"]
+    assert len(conv_names) == 1 + 18 + 2  # stem + block convs + 2 projections
+
+
+def test_tiny_resnet_trains():
+    """Residual (split/eltsum/batch_norm) family end-to-end under jit."""
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import resnet
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    conf = resnet(num_class=4, depth=8, widths=(4, 8, 8), input_side=16) \
+        + "batch_size = 8\ndev = cpu\neta = 0.05\nmetric = error\nsilent = 1\n"
+    t = NetTrainer()
+    for k, v in parse_config_string(conf):
+        t.set_param(k, v)
+    t.init_model()
+    rnd = np.random.RandomState(0)
+    b = DataBatch(data=rnd.rand(8, 3, 16, 16).astype(np.float32),
+                  label=rnd.randint(0, 4, (8, 1)).astype(np.float32),
+                  index=np.arange(8, dtype=np.uint32))
+    t.start_round(1)
+    losses = []
+    for _ in range(60):
+        t.update(b)
+        losses.append(float(t._last_loss))
+    assert losses[-1] < losses[0] * 0.7
