@@ -315,7 +315,8 @@ def resnet(num_class: int = 10, depth: int = 20,
     for si, w in enumerate(widths):
         for bi in range(n):
             stride = 2 if (si > 0 and bi == 0) else 1
-            side //= stride
+            # k=3/pad=1 conv: out side is ceil(side/stride), not floor
+            side = (side + 2 - 3) // stride + 1
             top = _res_block(lines, f"s{si}b{bi}", top, w,
                              stride, project=stride != 1)
     lines += [f"layer[{top}->gp] = avg_pooling",
