@@ -18,6 +18,8 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from .io.factory import create_iterator, init_iterator
 from .nnet.trainer import NetTrainer
 from .utils.config import parse_config_file, parse_keyval_args
@@ -39,6 +41,7 @@ class LearnTask:
         self.test_io = 0
         self.extract_node_name = ""
         self.prof_dir = ""
+        self.test_on_server = 0
         self.name_pred = "pred.txt"
         self.output_format = 1
         # default 1, reference nnet_impl-inl.hpp:22; gates both metric
@@ -85,6 +88,8 @@ class LearnTask:
             self.eval_train = int(val)
         elif name == "prof":
             self.prof_dir = val
+        elif name == "test_on_server":
+            self.test_on_server = int(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -265,6 +270,13 @@ class LearnTask:
                 if not self.silent:
                     print(f"profile trace written to {self.prof_dir}")
             rounds_done += 1
+            if self.test_on_server:
+                # per-round replica consistency check (the reference's
+                # test_on_server weight check, async_updater-inl.hpp:144-154)
+                drift = self.net.check_weight_consistency()
+                if drift != 0.0:
+                    raise RuntimeError(
+                        f"replica weights diverged (max abs diff {drift})")
             if self.test_io == 0:
                 line = f"[{self.start_counter}]"
                 # only print the train metric when the trainer actually
@@ -318,7 +330,8 @@ class LearnTask:
         node = self.extract_node_name
         assert node, "must set extract_node_name"
         print(f"start extracting feature from node {node} ...")
-        with open(self.name_pred, "w") as fo:
+        binary = self.output_format == 0
+        with open(self.name_pred, "wb" if binary else "w") as fo:
             self.itr_pred.before_first()
             wrote_meta = False
             while True:
@@ -330,8 +343,14 @@ class LearnTask:
                     with open(self.name_pred + ".meta", "w") as fm:
                         fm.write(f"{feat.shape[1]}\n")
                     wrote_meta = True
-                for row in feat:
-                    fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+                if binary:
+                    # raw little-endian float32 rows (reference
+                    # cxxnet_main.cpp:316 fwrite path)
+                    fo.write(np.ascontiguousarray(
+                        feat, dtype="<f4").tobytes())
+                else:
+                    for row in feat:
+                        fo.write(" ".join(f"{v:g}" for v in row) + "\n")
         print(f"finished extraction, write into {self.name_pred}")
 
     def run(self, argv: List[str]) -> int:

@@ -222,3 +222,42 @@ silent = 1
     cls = np.loadtxt(tmp_path / "cls.txt")
     assert cls.shape == (128,)
     assert set(np.unique(cls)) <= {0.0, 1.0, 2.0, 3.0}
+
+
+def test_cli_extract_binary_output(mnist_conf):
+    conf, tmp_path = mnist_conf
+    assert LearnTask().run([str(conf), "num_round=4"]) == 0
+    ex_conf = tmp_path / "ex.conf"
+    ex_conf.write_text(f"""
+dev = cpu
+task = extract
+model_in = {tmp_path}/models/0004.model
+extract_node_name = 2
+output_format = bin
+pred = {tmp_path}/feat.bin
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+silent = 1
+""")
+    assert LearnTask().run([str(ex_conf)]) == 0
+    dim = int((tmp_path / "feat.bin.meta").read_text().strip())
+    assert dim == 32  # fc1 width
+    raw = np.fromfile(tmp_path / "feat.bin", dtype="<f4")
+    assert raw.shape == (128 * 32,)
+    # text output of the same extraction must match the binary numbers
+    assert LearnTask().run([str(ex_conf), "output_format=txt",
+                            f"pred={tmp_path}/feat.txt"]) == 0
+    txt = np.loadtxt(tmp_path / "feat.txt").reshape(-1)
+    np.testing.assert_allclose(raw, txt, rtol=1e-4, atol=1e-5)
+
+
+def test_cli_train_test_on_server(mnist_conf):
+    """test_on_server=1 runs the replica-consistency check each round."""
+    conf, tmp_path = mnist_conf
+    assert LearnTask().run([str(conf), "num_round=3",
+                            "test_on_server=1", "dev=cpu:0-1"]) == 0
