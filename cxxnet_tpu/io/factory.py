@@ -30,6 +30,11 @@ def create_iterator(cfg: List[Tuple[str, str]]) -> IIterator:
             elif val == "imgbin" or val == "imgbinx":
                 assert it is None, "imgbin cannot chain over another iterator"
                 it = BatchAdaptIterator(AugmentIterator(ImageBinIterator()))
+                if val == "imgbinx":
+                    # the reference's imgbinx adds a decode thread stage
+                    # (iter_thread_imbin_x-inl.hpp); overridable by a later
+                    # decode_thread_num key
+                    it.set_param("decode_thread_num", "2")
             elif val == "imbin_native":
                 # C++ loader: decode + normalize + batch assembly off-Python
                 from .native import NativeImageBinIterator

@@ -139,8 +139,10 @@ class ImageBinIterator(IIterator):
         self.dist_worker_rank = 0
         self.label_width = 1
         self.seed_data = 0
+        self.decode_thread_num = 0
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
+        self._pool = None
         self._gen = 0
 
     def set_param(self, name, val):
@@ -162,6 +164,8 @@ class ImageBinIterator(IIterator):
             self.label_width = int(val)
         elif name == "seed_data":
             self.seed_data = int(val)
+        elif name == "decode_thread_num":
+            self.decode_thread_num = int(val)
 
     def init(self):
         rank = int(os.environ.get("PS_RANK", self.dist_worker_rank))
@@ -265,11 +269,28 @@ class ImageBinIterator(IIterator):
             if item is None:
                 self._done = True
                 return None
+            if self.decode_thread_num > 0:
+                # two-stage pipeline (reference imgbinx,
+                # iter_thread_imbin_x-inl.hpp:304-330): the whole page's
+                # jpegs decode on a pool (cv2 releases the GIL) while the
+                # consumer drains earlier instances
+                if self._pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=self.decode_thread_num,
+                        thread_name_prefix="imbin-decode")
+                item = [(li, self._pool.submit(_decode_jpeg, buf))
+                        for li, buf in item]
             self._page = item
             self._page_pos = 0
-        li, buf = self._page[self._page_pos]
+        li, payload = self._page[self._page_pos]
+        # drop the consumed entry so decoded arrays don't accumulate for the
+        # whole page while the pool runs ahead
+        self._page[self._page_pos] = None
         self._page_pos += 1
-        return DataInst(label=self.labels[li], data=_decode_jpeg(buf),
+        data = payload.result() if self.decode_thread_num > 0 \
+            else _decode_jpeg(payload)
+        return DataInst(label=self.labels[li], data=data,
                         index=self.indices[li])
 
 

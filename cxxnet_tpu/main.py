@@ -91,6 +91,9 @@ class LearnTask:
         elif name == "test_on_server":
             self.test_on_server = int(val)
         elif name == "output_format":
+            if val not in ("txt", "bin"):
+                raise ValueError(
+                    f"output_format must be 'txt' or 'bin', got {val!r}")
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
 

@@ -609,8 +609,10 @@ class NetTrainer:
             for group in by_index.values():
                 base = np.asarray(group[0].data)
                 for s in group[1:]:
-                    worst = max(worst, float(np.abs(
-                        np.asarray(s.data) - base).max()))
+                    d = np.abs(np.asarray(s.data) - base).max()
+                    if np.isnan(d):  # NaN-vs-finite IS divergence;
+                        return float("inf")  # max() would silently drop it
+                    worst = max(worst, float(d))
         return worst
 
 
