@@ -269,23 +269,29 @@ class ImageBinIterator(IIterator):
             if item is None:
                 self._done = True
                 return None
-            if self.decode_thread_num > 0:
-                # two-stage pipeline (reference imgbinx,
-                # iter_thread_imbin_x-inl.hpp:304-330): the whole page's
-                # jpegs decode on a pool (cv2 releases the GIL) while the
-                # consumer drains earlier instances
-                if self._pool is None:
-                    from concurrent.futures import ThreadPoolExecutor
-                    self._pool = ThreadPoolExecutor(
-                        max_workers=self.decode_thread_num,
-                        thread_name_prefix="imbin-decode")
-                item = [(li, self._pool.submit(_decode_jpeg, buf))
-                        for li, buf in item]
             self._page = item
             self._page_pos = 0
+            self._submit_pos = 0
+        if self.decode_thread_num > 0:
+            # two-stage pipeline (reference imgbinx,
+            # iter_thread_imbin_x-inl.hpp:304-330): jpegs decode on a pool
+            # (cv2 releases the GIL) while the consumer drains earlier
+            # instances.  The submit window is bounded so decoded float32
+            # arrays never accumulate page-wide ahead of the consumer.
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.decode_thread_num,
+                    thread_name_prefix="imbin-decode")
+            window = 2 * self.decode_thread_num
+            while (self._submit_pos < len(self._page)
+                   and self._submit_pos - self._page_pos < window):
+                i = self._submit_pos
+                li, buf = self._page[i]
+                self._page[i] = (li, self._pool.submit(_decode_jpeg, buf))
+                self._submit_pos += 1
         li, payload = self._page[self._page_pos]
-        # drop the consumed entry so decoded arrays don't accumulate for the
-        # whole page while the pool runs ahead
+        # drop the consumed entry so its decoded array is freed promptly
         self._page[self._page_pos] = None
         self._page_pos += 1
         data = payload.result() if self.decode_thread_num > 0 \

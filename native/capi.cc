@@ -70,6 +70,10 @@ def net_set_weight(net, buf, size, layer, tag):
 def io_create(cfg):
     return DataIter(cfg)
 
+def run_task(args):
+    from cxxnet_tpu.main import LearnTask
+    return LearnTask().run(list(args))
+
 def io_get_data(it):
     return _c(it.get_data())
 
@@ -437,6 +441,31 @@ const cxx_real_t *CXNIOGetLabel(void *handle, cxx_ulong *out_shape,
   PyObject *args = Py_BuildValue("(O)", h->obj);
   return return_array(h, call_helper("io_get_label", args), out_shape,
                       out_ndim);
+}
+
+/* ---- task driver ---- */
+
+int CXNRunTask(int argc, const char **argv) {
+  API_PROLOG(-1);
+  PyObject *lst = PyList_New(argc);
+  if (lst == nullptr) { set_error_from_python(); return -1; }
+  for (int i = 0; i < argc; ++i) {
+    /* DecodeFSDefault: argv may be arbitrary bytes (paths), not UTF-8 */
+    PyObject *s = PyUnicode_DecodeFSDefault(argv[i]);
+    if (s == nullptr) {
+      set_error_from_python();
+      Py_DECREF(lst);
+      return -1;
+    }
+    PyList_SetItem(lst, i, s);  /* steals ref */
+  }
+  PyObject *args = Py_BuildValue("(O)", lst);
+  Py_DECREF(lst);
+  PyObject *r = call_helper("run_task", args);
+  if (r == nullptr) return -1;
+  long rc = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return static_cast<int>(rc);
 }
 
 }  /* extern "C" */

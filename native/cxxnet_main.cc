@@ -1,0 +1,23 @@
+/*
+ * Standalone trainer binary: `cxxnet <config.conf> [key=value ...]` — the
+ * reference's single-binary UX (src/cxxnet_main.cpp, bin/cxxnet) over the
+ * C ABI (embedded CPython running the cxxnet_tpu task driver).
+ */
+#include <cstdio>
+
+#include "capi.h"
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "Usage: %s <config.conf> [key=value ...]\n",
+                 argv[0]);
+    return 1;
+  }
+  int rc = CXNRunTask(argc - 1, const_cast<const char **>(argv + 1));
+  if (rc != 0) {
+    const char *err = CXNGetLastError();
+    if (err != nullptr && err[0] != '\0')
+      std::fprintf(stderr, "cxxnet: %s\n", err);
+  }
+  return rc;
+}

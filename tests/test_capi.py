@@ -160,3 +160,47 @@ def test_capi_demo_subprocess():
                        capture_output=True, env=env, timeout=300)
     assert r.returncode == 0, r.stderr.decode()[-400:]
     assert b"accuracy" in r.stdout
+
+def test_cxxnet_binary_trains(tmp_path):
+    """The standalone `cxxnet` binary (reference bin/cxxnet UX) runs the
+    full train task from a config file."""
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                        "cxxnet"], capture_output=True)
+    if r.returncode != 0:
+        pytest.skip("cannot build cxxnet binary")
+    subprocess.run([sys.executable,
+                    os.path.join(REPO, "tools", "make_synth_mnist.py"),
+                    "--out", str(tmp_path), "--train", "256", "--test", "64"],
+                   check=True)
+    conf = tmp_path / "t.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = mnist
+  path_img = {tmp_path}/train-images-idx3-ubyte.gz
+  path_label = {tmp_path}/train-labels-idx1-ubyte.gz
+  shuffle = 1
+iter = end
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 32
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 10
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 32
+eta = 0.1
+num_round = 2
+metric = error
+model_dir = {tmp_path}/models
+silent = 1
+""")
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([os.path.join(REPO, "native", "cxxnet"), str(conf)],
+                       capture_output=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr.decode()[-400:]
+    assert b"train-error" in r.stderr
+    assert (tmp_path / "models" / "0002.model").exists()
