@@ -108,11 +108,14 @@ void set_error_from_python() {
   Py_XDECREF(ptb);
 }
 
+bool g_we_initialized = false;
+
 bool ensure_init() {
   static std::once_flag once;
   static bool ok = false;
   std::call_once(once, [] {
     if (!Py_IsInitialized()) {
+      g_we_initialized = true;
       Py_InitializeEx(0);
       /* release the GIL taken by Py_Initialize; every entry point below
          re-acquires via PyGILState_Ensure */
@@ -466,6 +469,21 @@ int CXNRunTask(int argc, const char **argv) {
   long rc = PyLong_AsLong(r);
   Py_DECREF(r);
   return static_cast<int>(rc);
+}
+
+void CXNShutdown(void) {
+  if (!Py_IsInitialized()) return;
+  {
+    Gil gil_;
+    PyRun_SimpleString(
+        "import sys; sys.stdout.flush(); sys.stderr.flush()");
+  }
+  if (g_we_initialized) {
+    /* re-acquire the thread state released in ensure_init, then tear down */
+    PyGILState_Ensure();
+    Py_FinalizeEx();
+    g_we_initialized = false;
+  }
 }
 
 }  /* extern "C" */

@@ -69,18 +69,24 @@ int CXNNetSetWeight(void *handle, const cxx_real_t *weight, cxx_ulong size,
 /* ---- data iterators ---- */
 void *CXNIOCreateFromConfig(const char *cfg);
 void CXNIOFree(void *handle);
-
-/* Run a full CLI task (train/finetune/pred/pred_raw/extract) from a config
- * file + key=value overrides — argv as for `python -m cxxnet_tpu`, without
- * the program name.  Returns the task's exit code, -1 on error.  Backs the
- * standalone `cxxnet` binary (reference: bin/cxxnet <conf> [k=v...]). */
-int CXNRunTask(int argc, const char **argv);
 int CXNIONext(void *handle); /* 1 = has batch, 0 = end, -1 = error */
 int CXNIOBeforeFirst(void *handle);
 const cxx_real_t *CXNIOGetData(void *handle, cxx_ulong *out_shape,
                                int *out_ndim);
 const cxx_real_t *CXNIOGetLabel(void *handle, cxx_ulong *out_shape,
                                 int *out_ndim);
+
+/* ---- task driver ---- */
+/* Run a full CLI task (train/finetune/pred/pred_raw/extract) from a config
+ * file + key=value overrides — argv as for `python -m cxxnet_tpu`, without
+ * the program name.  Returns the task's exit code, -1 on error.  Backs the
+ * standalone `cxxnet` binary (reference: bin/cxxnet <conf> [k=v...]). */
+int CXNRunTask(int argc, const char **argv);
+
+/* Flush the embedded interpreter's stdio buffers and, when this library
+ * initialised the interpreter, finalize it.  Call before process exit from
+ * plain C/C++ hosts so Python-buffered output reaches redirected files. */
+void CXNShutdown(void);
 
 #ifdef __cplusplus
 }

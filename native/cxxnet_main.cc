@@ -19,5 +19,6 @@ int main(int argc, char **argv) {
     if (err != nullptr && err[0] != '\0')
       std::fprintf(stderr, "cxxnet: %s\n", err);
   }
+  CXNShutdown();  /* flush python-buffered stdout before C exit */
   return rc;
 }
