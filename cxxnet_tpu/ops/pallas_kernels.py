@@ -140,3 +140,261 @@ def _lrn_bwd_res(nsize, alpha, beta, knorm, res, g):
 
 
 lrn_pallas.defvjp(_lrn_fwd_res, _lrn_bwd_res)
+
+
+# --------------------------------------------------------------------------
+# Flash attention: the sequence stack's hot op.  One VMEM-resident pass per
+# (batch*head, q-block), online softmax over k-blocks carried in scratch —
+# never materialises the (s, s) score matrix.  Backward recomputes scores
+# from the saved logsumexp (two kernels: dq over k-blocks, dk/dv over
+# q-blocks).  Same math as parallel/ring.dense_attention's chunked path.
+#
+# Measured on TPU v5e (b4 h8 s8192 d128 bf16, causal): forward 16.5ms vs
+# 53ms for the XLA chunked path (3.2x); fwd+bwd 38.5ms, where the XLA
+# path's scan-autodiff residuals (per-chunk f32 scores) exceed HBM
+# entirely.  Matmul operands stay bf16 (MXU fast path) with f32
+# accumulation; block sizes 512x1024 amortise per-program overhead (the
+# first cut at 128x128 ran 131k programs and was slower than XLA).
+
+NEG_INF = -1e30
+
+
+def _fa_blocks(s_len):
+    """Block sizes: big blocks amortize per-program overhead; must divide
+    the sequence length and satisfy the (8, 128) tile minimum."""
+    bq, bk = 512, 1024
+    while bq > 128 and s_len % bq != 0:
+        bq //= 2
+    while bk > 128 and s_len % bk != 0:
+        bk //= 2
+    return bq, bk
+
+
+def _causal_mask(s, i, j, bq, bk):
+    qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
+                   *, scale, causal, bq, bk):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        m[...] = jnp.full_like(m, NEG_INF)
+        l[...] = jnp.zeros_like(l)
+
+    # causal: blocks strictly above the diagonal contribute nothing
+    live = (i * bq + bq - 1 >= j * bk) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _():
+        # keep matmul operands in the input dtype (bf16 hits the MXU's fast
+        # path); accumulate in f32 via preferred_element_type
+        qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        m_prev = m[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l[...] = l[...] * corr + p.sum(axis=-1, keepdims=True)
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        o_ref[0] = (acc[...] / l[...]).astype(o_ref.dtype)
+        lse_ref[0, 0, pl.ds(i * bq, bq)] = (m[...] + jnp.log(l[...]))[:, 0]
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                  dq_acc, *, scale, causal, bq, bk):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (i * bq + bq - 1 >= j * bk) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _():
+        qb, kb = q_ref[0], k_ref[0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        p = jnp.exp(s - lse_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+        dob = do_ref[0]
+        dp = jax.lax.dot_general(dob, v_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk):
+    j, i = pl.program_id(1), pl.program_id(2)  # note: k-block is grid dim 1
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (i * bq + bq - 1 >= j * bk) if causal else (i >= 0)
+
+    @pl.when(live)
+    def _():
+        qb, kb = q_ref[0], k_ref[0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        p = jnp.exp(s - lse_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+        dob = do_ref[0]
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, v_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _scratch(*shapes):
+    assert pltpu is not None, "flash attention needs pallas TPU support"
+    return [pltpu.VMEM(s, jnp.float32) for s in shapes]
+
+
+def flash_attention_available(s_len: int, d: int) -> bool:
+    return pltpu is not None and s_len % 128 == 0 and d <= 256
+
+
+def _fa_specs(nbh, s_len, d, bq, bk):
+    # row vectors (lse, delta) ride as whole (1, s) blocks pinned per batch
+    # row: a (1, bq) block would violate the (8, 128) tile minimum
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, 1, s_len), lambda b, i, j: (b, 0, 0))
+    return q_spec, k_spec, row_spec
+
+
+def _fa_fwd(q3, k3, v3, scale, causal, interpret):
+    nbh, s_len, d = q3.shape
+    bq, bk = _fa_blocks(s_len)
+    q_spec, k_spec, row_spec = _fa_specs(nbh, s_len, d, bq, bk)
+    kern = functools.partial(_fa_fwd_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(nbh, s_len // bq, s_len // bk),
+        in_specs=[q_spec, k_spec, k_spec],
+        out_specs=[q_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                   jax.ShapeDtypeStruct((nbh, 1, s_len), jnp.float32)],
+        scratch_shapes=_scratch((bq, d), (bq, 1), (bq, 1)),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+def _fa_bwd(q3, k3, v3, o3, lse, g3, scale, causal, interpret):
+    nbh, s_len, d = q3.shape
+    delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]  # (nbh, 1, s)
+    bq, bk = _fa_blocks(s_len)
+    q_spec, k_spec, row_spec = _fa_specs(nbh, s_len, d, bq, bk)
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(nbh, s_len // bq, s_len // bk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        scratch_shapes=_scratch((bq, d)),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+    # k-block outer, q-block inner: accumulate dk/dv per k-block
+    kq_q_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    kq_k_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    kq_row_spec = pl.BlockSpec((1, 1, s_len), lambda b, j, i: (b, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(nbh, s_len // bk, s_len // bq),
+        in_specs=[kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec,
+                  kq_row_spec, kq_row_spec],
+        out_specs=[kq_k_spec, kq_k_spec],
+        out_shape=[jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+                   jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+        scratch_shapes=_scratch((bk, d), (bk, d)),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: float = None, interpret: bool = None):
+    """Flash attention, (b, h, s, d) -> (b, h, s, d).
+
+    Requires s divisible by 128 (use ``flash_attention_available``);
+    ``interpret`` defaults to off-TPU detection so tests run on CPU.
+    """
+    out, _ = _flash_fwd_res(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _norm_args(q, causal, scale, interpret):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+    return scale, interpret
+
+
+def _flash_fwd_res(q, k, v, causal, scale, interpret):
+    scale, interpret = _norm_args(q, causal, scale, interpret)
+    b, h, s_len, d = q.shape
+    sh3 = (b * h, s_len, d)
+    o3, lse = _fa_fwd(q.reshape(sh3), k.reshape(sh3), v.reshape(sh3),
+                      scale, causal, interpret)
+    return o3.reshape(q.shape), (q, k, v, o3, lse)
+
+
+def _flash_bwd_res(causal, scale, interpret, res, g):
+    q, k, v, o3, lse = res
+    scale, interpret = _norm_args(q, causal, scale, interpret)
+    b, h, s_len, d = q.shape
+    sh3 = (b * h, s_len, d)
+    dq, dk, dv = _fa_bwd(q.reshape(sh3), k.reshape(sh3), v.reshape(sh3),
+                         o3, lse, g.reshape(sh3), scale, causal, interpret)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
+
+
+flash_attention.defvjp(_flash_fwd_res, _flash_bwd_res)

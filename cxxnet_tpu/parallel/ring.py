@@ -48,20 +48,58 @@ def _online_update(s, v, acc, m, l):
     p = jnp.exp(s - new_m)
     corr = jnp.exp(m - new_m)
     l = l * corr + p.sum(axis=-1, keepdims=True)
-    acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                                  preferred_element_type=jnp.float32)
     return acc, new_m, l
+
+
+CHUNKED_ATTN_THRESHOLD = 2048  # above this seq len, never materialize s x s
 
 
 def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False,
                     scale: Optional[float] = None) -> jnp.ndarray:
-    """Plain softmax attention, (b, h, s, d) -> (b, h, s, d)."""
+    """Plain softmax attention, (b, h, s, d) -> (b, h, s, d).
+
+    Short sequences take the direct path; past ``CHUNKED_ATTN_THRESHOLD``
+    the K/V axis is processed in online-softmax chunks under ``lax.scan``
+    so peak memory is O(s·chunk) instead of O(s²) — the single-chip
+    long-context path (ring_attention is the multi-chip one)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = _block_scores(q, k, scale, 0, 0, causal)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(p.dtype)).astype(q.dtype)
+    s_len = k.shape[2]
+    if s_len <= CHUNKED_ATTN_THRESHOLD:
+        s = _block_scores(q, k, scale, 0, 0, causal)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v.astype(p.dtype)).astype(q.dtype)
+    chunk = _chunk_for(s_len)
+    n_chunks = s_len // chunk
+    kc = k.reshape(k.shape[0], k.shape[1], n_chunks, chunk, k.shape[3])
+    vc = v.reshape(v.shape[0], v.shape[1], n_chunks, chunk, v.shape[3])
+    acc = jnp.zeros(q.shape[:3] + (v.shape[3],), jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+
+    def step(carry, inp):
+        acc, m, l, k_off = carry
+        kb, vb = inp
+        s = _block_scores(q, kb, scale, 0, k_off, causal)
+        acc, m, l = _online_update(s, vb, acc, m, l)
+        return (acc, m, l, k_off + chunk), None
+
+    (acc, m, l, _), _ = lax.scan(
+        step, (acc, m, l, jnp.int32(0)),
+        (jnp.moveaxis(kc, 2, 0), jnp.moveaxis(vc, 2, 0)))
+    return (acc / l).astype(q.dtype)
+
+
+def _chunk_for(s_len: int) -> int:
+    """Largest power-of-two chunk <= 1024 dividing the sequence length."""
+    c = 1024
+    while c > 1 and s_len % c != 0:
+        c //= 2
+    return c
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -79,7 +117,6 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_local = q.shape[2]
-    q32 = q.astype(jnp.float32)
     q_off = my * s_local
     acc = jnp.zeros(q.shape[:3] + (v.shape[3],), jnp.float32)
     m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
@@ -89,8 +126,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # pipeline of (matmul, ppermute) pairs it can overlap
     for i in range(n):
         src = (my - i) % n  # the shard whose K/V block we currently hold
-        s = _block_scores(q32, k.astype(jnp.float32), scale,
-                          q_off, src * k.shape[2], causal)
+        s = _block_scores(q, k, scale, q_off, src * k.shape[2], causal)
         acc, m, l = _online_update(s, v, acc, m, l)
         if i + 1 < n:
             k = lax.ppermute(k, axis_name, perm)

@@ -19,6 +19,7 @@
 namespace {
 
 std::string g_last_error;
+bool g_shutdown = false;
 
 /* python helper functions, defined once in a private dict */
 const char *kHelperSrc = R"PY(
@@ -203,8 +204,13 @@ struct Gil {
   ~Gil() { PyGILState_Release(st); }
 };
 
-#define API_PROLOG(defval)               \
-  if (!ensure_init()) return defval;     \
+#define API_PROLOG(defval)                                  \
+  if (g_shutdown) {                                           \
+    g_last_error = "CXNShutdown was called; the library "     \
+                   "cannot be used afterwards";               \
+    return defval;                                            \
+  }                                                           \
+  if (!ensure_init()) return defval;                          \
   Gil gil_;
 
 }  // namespace
@@ -472,18 +478,22 @@ int CXNRunTask(int argc, const char **argv) {
 }
 
 void CXNShutdown(void) {
-  if (!Py_IsInitialized()) return;
+  if (g_shutdown || !Py_IsInitialized()) return;
   {
     Gil gil_;
     PyRun_SimpleString(
         "import sys; sys.stdout.flush(); sys.stderr.flush()");
+    Py_XDECREF(g_helpers);
   }
+  g_helpers = nullptr;  /* would dangle across an interpreter cycle */
   if (g_we_initialized) {
     /* re-acquire the thread state released in ensure_init, then tear down */
     PyGILState_Ensure();
     Py_FinalizeEx();
     g_we_initialized = false;
   }
+  /* one-way: every later CXN* call fails cleanly via API_PROLOG */
+  g_shutdown = true;
 }
 
 }  /* extern "C" */

@@ -90,16 +90,16 @@ def test_capi_train_predict(capi):
     assert capi.CXNNetInitModel(net) == 0, capi.CXNGetLastError()
 
     rng = np.random.RandomState(0)
-    for step in range(40):
+    for step in range(80):
         x = rng.rand(16, 1, 1, 6).astype(np.float32)
         y = (x.reshape(16, 6).sum(1) > 3).astype(np.float32).reshape(16, 1)
-        x[:, 0, 0, 0] += y[:, 0]  # make it separable
+        x[:, 0, 0, 0] += 2.0 * y[:, 0]  # make it clearly separable
         assert capi.CXNNetUpdateBatch(net, _f32(x), _u64(16, 1, 1, 6), 4,
                                       _f32(y), _u64(16, 1), 2) == 0
 
     x = rng.rand(16, 1, 1, 6).astype(np.float32)
     y = (x.reshape(16, 6).sum(1) > 3).astype(np.float32)
-    x[:, 0, 0, 0] += y
+    x[:, 0, 0, 0] += 2.0 * y
     oshape = _u64(0, 0, 0, 0)
     ondim = ctypes.c_int(0)
     pred = capi.CXNNetPredictBatch(net, _f32(x), _u64(16, 1, 1, 6), 4,

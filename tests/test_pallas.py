@@ -51,3 +51,56 @@ def test_lrn_dispatch_forced_pallas(monkeypatch):
     want = _xla_lrn(x, 5, 0.001, 0.75, 1.0)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_flash_attention_matches_dense():
+    """Pallas flash attention (interpret mode on CPU) == dense attention,
+    forward and backward, causal and not, bf16 and f32."""
+    from cxxnet_tpu.ops.pallas_kernels import (flash_attention,
+                                               flash_attention_available)
+    from cxxnet_tpu.parallel.ring import dense_attention
+    assert flash_attention_available(256, 64)
+    assert not flash_attention_available(250, 64)  # not divisible by 128
+    rnd = np.random.RandomState(0)
+    for dtype, tol in ((np.float32, 5e-6), (jnp.bfloat16, 5e-2)):
+        q, k, v = (jnp.asarray(
+            rnd.randn(1, 2, 256, 64).astype(np.float32) * 0.5).astype(dtype)
+            for _ in range(3))
+        for causal in (False, True):
+            out = flash_attention(q, k, v, causal)
+            ref = dense_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                atol=tol)
+            gf = jax.grad(lambda *a: jnp.sum(
+                flash_attention(*a, causal).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(lambda *a: jnp.sum(
+                dense_attention(*a, causal=causal).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gf, gr):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    atol=tol * 40)
+
+
+def test_flash_attention_asymmetric_blocks():
+    """Sequence lengths hitting the bq!=bk path (512/1024 blocks)."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    from cxxnet_tpu.parallel.ring import dense_attention
+    assert pk._fa_blocks(8192) == (512, 1024)
+    assert pk._fa_blocks(512) == (512, 512)
+    assert pk._fa_blocks(128) == (128, 128)
+    rnd = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rnd.randn(1, 1, 1024, 32).astype(np.float32) * 0.5)
+               for _ in range(3))
+    out = pk.flash_attention(q, k, v, True)
+    # chunked reference at this length
+    import cxxnet_tpu.parallel.ring as ring
+    old = ring.CHUNKED_ATTN_THRESHOLD
+    try:
+        ring.CHUNKED_ATTN_THRESHOLD = 128
+        ref = dense_attention(q, k, v, causal=True)
+    finally:
+        ring.CHUNKED_ATTN_THRESHOLD = old
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -177,3 +177,37 @@ def test_transformer_seq_parallel_matches_single():
     l1, _ = _train_lm({"dev": "cpu"}, steps=3)
     l2, _ = _train_lm({"dev": "cpu:0-7", "mesh": "data:2,seq:4"}, steps=3)
     np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+
+def test_chunked_dense_attention_matches_direct():
+    """Past the chunk threshold, attention runs online-softmax chunks under
+    scan (O(s*chunk) memory) and must match the direct path bit-for-bit-ish,
+    forward and backward, causal and not."""
+    import cxxnet_tpu.parallel.ring as ring
+    rnd = np.random.RandomState(0)
+    b, h, s, d = 1, 2, 64, 8
+    q, k, v = (jnp.asarray(rnd.randn(b, h, s, d).astype(np.float32))
+               for _ in range(3))
+    old_thresh, old_chunk = ring.CHUNKED_ATTN_THRESHOLD, ring._chunk_for
+    try:
+        for causal in (False, True):
+            ring.CHUNKED_ATTN_THRESHOLD = 4096
+            ref = ring.dense_attention(q, k, v, causal=causal)
+            g_ref = jax.grad(lambda *a: jnp.sum(
+                ring.dense_attention(*a, causal=causal) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            ring.CHUNKED_ATTN_THRESHOLD = 16
+            ring._chunk_for = lambda s_len: 16  # 4 real chunks
+            out = ring.dense_attention(q, k, v, causal=causal)
+            g_out = jax.grad(lambda *a: jnp.sum(
+                ring.dense_attention(*a, causal=causal) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            ring._chunk_for = old_chunk
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                       atol=2e-6)
+            for a, b_ in zip(g_ref, g_out):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                           atol=1e-5)
+    finally:
+        ring.CHUNKED_ATTN_THRESHOLD = old_thresh
+        ring._chunk_for = old_chunk
