@@ -53,6 +53,37 @@ def _online_update(s, v, acc, m, l):
     return acc, new_m, l
 
 
+def _accumulate_block(q, k, v, scale, q_off, k_off, causal, acc, m, l):
+    """Fold one K/V block into the (acc, m, l) online-softmax state.
+
+    Chunks the block's key axis under ``lax.scan`` when it is long, so
+    peak memory stays O(s_q · chunk) regardless of the block size — used
+    both by the single-device chunked path and by each ring rotation step
+    (whose local blocks are s/ring long and would otherwise materialise
+    (s_local, s_local) f32 scores)."""
+    s_len = k.shape[2]
+    chunk = _chunk_for(s_len)
+    if chunk == s_len or s_len <= CHUNKED_ATTN_THRESHOLD:
+        s = _block_scores(q, k, scale, q_off, k_off, causal)
+        return _online_update(s, v, acc, m, l)
+    n_chunks = s_len // chunk
+    kc = jnp.moveaxis(
+        k.reshape(k.shape[0], k.shape[1], n_chunks, chunk, k.shape[3]), 2, 0)
+    vc = jnp.moveaxis(
+        v.reshape(v.shape[0], v.shape[1], n_chunks, chunk, v.shape[3]), 2, 0)
+
+    def step(carry, inp):
+        acc, m, l, off = carry
+        kb, vb = inp
+        s = _block_scores(q, kb, scale, q_off, off, causal)
+        acc, m, l = _online_update(s, vb, acc, m, l)
+        return (acc, m, l, off + chunk), None
+
+    (acc, m, l, _), _ = lax.scan(
+        step, (acc, m, l, jnp.asarray(k_off, jnp.int32)), (kc, vc))
+    return acc, m, l
+
+
 CHUNKED_ATTN_THRESHOLD = 2048  # above this seq len, never materialize s x s
 
 
@@ -73,24 +104,10 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p,
                           v.astype(p.dtype)).astype(q.dtype)
-    chunk = _chunk_for(s_len)
-    n_chunks = s_len // chunk
-    kc = k.reshape(k.shape[0], k.shape[1], n_chunks, chunk, k.shape[3])
-    vc = v.reshape(v.shape[0], v.shape[1], n_chunks, chunk, v.shape[3])
     acc = jnp.zeros(q.shape[:3] + (v.shape[3],), jnp.float32)
     m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
     l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
-
-    def step(carry, inp):
-        acc, m, l, k_off = carry
-        kb, vb = inp
-        s = _block_scores(q, kb, scale, 0, k_off, causal)
-        acc, m, l = _online_update(s, vb, acc, m, l)
-        return (acc, m, l, k_off + chunk), None
-
-    (acc, m, l, _), _ = lax.scan(
-        step, (acc, m, l, jnp.int32(0)),
-        (jnp.moveaxis(kc, 2, 0), jnp.moveaxis(vc, 2, 0)))
+    acc, m, l = _accumulate_block(q, k, v, scale, 0, 0, causal, acc, m, l)
     return (acc / l).astype(q.dtype)
 
 
@@ -126,8 +143,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # pipeline of (matmul, ppermute) pairs it can overlap
     for i in range(n):
         src = (my - i) % n  # the shard whose K/V block we currently hold
-        s = _block_scores(q, k, scale, q_off, src * k.shape[2], causal)
-        acc, m, l = _online_update(s, v, acc, m, l)
+        acc, m, l = _accumulate_block(q, k, v, scale, q_off,
+                                      src * k.shape[2], causal, acc, m, l)
         if i + 1 < n:
             k = lax.ppermute(k, axis_name, perm)
             v = lax.ppermute(v, axis_name, perm)

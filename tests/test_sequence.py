@@ -211,3 +211,30 @@ def test_chunked_dense_attention_matches_direct():
     finally:
         ring.CHUNKED_ATTN_THRESHOLD = old_thresh
         ring._chunk_for = old_chunk
+
+
+def test_ring_attention_chunked_local_blocks():
+    """Each ring step folds its K/V block in k-chunks (no s_local^2 score
+    matrix); must still match dense attention exactly."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
+    rnd = np.random.RandomState(0)
+    b, h, s, d = 1, 2, 64, 8
+    q, k, v = (jnp.asarray(rnd.randn(b, h, s, d).astype(np.float32))
+               for _ in range(3))
+    old = ring._chunk_for
+    old_thresh = ring.CHUNKED_ATTN_THRESHOLD
+    ring._chunk_for = lambda n: max(n // 4, 1) if n % 4 == 0 else n
+    ring.CHUNKED_ATTN_THRESHOLD = 8  # force the chunked path for tiny blocks
+    try:
+        for causal in (False, True):
+            out = ring.sharded_attention(q, k, v, mesh, causal=causal)
+            # reference must not chunk: restore the real threshold for it
+            ring.CHUNKED_ATTN_THRESHOLD = old_thresh
+            ref = ring.dense_attention(q, k, v, causal=causal)
+            ring.CHUNKED_ATTN_THRESHOLD = 8
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-6)
+    finally:
+        ring._chunk_for = old
+        ring.CHUNKED_ATTN_THRESHOLD = old_thresh
