@@ -1,0 +1,30 @@
+"""Sanity guards for bench.py: the driver runs it unattended at round end,
+so import errors or broken FLOP accounting must be caught in CI."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_bench_imports_and_flop_count():
+    import bench
+    from __graft_entry__ import ALEXNET_NET, _make_trainer
+    t = _make_trainer(ALEXNET_NET, 2, "cpu")
+    fwd = bench.conv_flops_per_image(t.net)
+    # AlexNet forward is ~1.4-1.5 GFLOP/image (the well-known figure)
+    assert 1.2e9 < fwd < 1.7e9, fwd
+
+
+def test_bench_baseline_json_shape():
+    """The driver parses one JSON object with these exact keys."""
+    import json
+
+    import bench
+    payload = json.loads(json.dumps(bench.baseline_json(1234.56)))
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+    assert payload["metric"] == "alexnet_imgs_per_sec_per_chip"
+    assert payload["value"] == 1234.6
+    assert payload["vs_baseline"] == round(1234.56 / 1000.0, 3)
