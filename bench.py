@@ -89,7 +89,9 @@ def bench_transformer() -> float:
     rnd = np.random.RandomState(0)
     toks = rnd.randint(0, vocab, (scan_len, batch, 1, 1, seq))
     datas = jnp.asarray(toks.astype(np.float32))
-    # next-token objective: position t is scored against token t+1
+    # next-token objective: position t is scored against token t+1 (the
+    # last position wraps to token 0 — irrelevant for random-token
+    # throughput, do not reuse for perplexity)
     labels = jnp.asarray(np.roll(toks, -1, axis=-1)
                          .reshape(scan_len, batch, seq).astype(np.float32))
     t.start_round(1)
@@ -141,6 +143,7 @@ def main() -> None:
     print(f"bench: AlexNet b{batch} step={step_ms:.1f}ms "
           f"imgs/sec={imgs_per_sec:.1f} fwd_gflops/img={flops_fwd / 1e9:.2f} "
           f"device={dev_kind} MFU={mfu * 100:.1f}%", file=sys.stderr)
+    del t, datas, labels, losses  # free HBM before the secondary benches
     try:
         lenet_ms = bench_lenet()
         print(f"bench: LeNet b512 step={lenet_ms:.2f}ms "

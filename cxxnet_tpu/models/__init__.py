@@ -9,6 +9,7 @@ language, so everything downstream (NetConfig, trainer, checkpointing,
 wrapper) treats zoo models identically to user-written config files.
 """
 
-from .zoo import alexnet, googlenet, lenet, mlp, resnet, transformer
+from .zoo import alexnet, googlenet, lenet, mlp, resnet, transformer, vgg
 
-__all__ = ["alexnet", "googlenet", "lenet", "mlp", "resnet", "transformer"]
+__all__ = ["alexnet", "googlenet", "lenet", "mlp", "resnet",
+           "transformer", "vgg"]

@@ -328,3 +328,33 @@ def resnet(num_class: int = 10, depth: int = 20,
               "netconfig=end",
               f"input_shape = 3,{input_side},{input_side}"]
     return "\n".join(lines) + "\n"
+
+
+def vgg(num_class: int = 1000, depth: int = 16) -> str:
+    """VGG-11/13/16/19: stacked 3x3 convs with 2x2 max pooling, three fullc
+    layers with dropout.  Expressible entirely with the reference's layer
+    zoo (conv/relu/max_pooling/fullc/dropout/softmax); no reference config
+    exists, so this builder is authored like googlenet above."""
+    plans = {11: (1, 1, 2, 2, 2), 13: (2, 2, 2, 2, 2),
+             16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}
+    assert depth in plans, f"vgg: depth must be one of {sorted(plans)}"
+    widths = (64, 128, 256, 512, 512)
+    lines = ["netconfig=start"]
+    for si, (reps, w) in enumerate(zip(plans[depth], widths)):
+        for ri in range(reps):
+            lines += [f"layer[+1] = conv:s{si}c{ri}",
+                      "  kernel_size = 3", "  pad = 1", f"  nchannel = {w}"]
+            lines += ["layer[+0] = relu"]
+        lines += ["layer[+1] = max_pooling", "  kernel_size = 2",
+                  "  stride = 2"]
+    lines += ["layer[+1] = flatten"]
+    for i, nh in enumerate((4096, 4096)):
+        lines += [f"layer[+1] = fullc:fc{i + 6}", f"  nhidden = {nh}",
+                  "layer[+0] = relu", "layer[+0] = dropout",
+                  "  threshold = 0.5"]
+    lines += [f"layer[+1] = fullc:fc8", f"  nhidden = {num_class}",
+              "layer[+0] = softmax",
+              "netconfig=end",
+              "input_shape = 3,224,224",
+              "random_type = xavier"]
+    return "\n".join(lines) + "\n"

@@ -209,3 +209,45 @@ def test_tiny_resnet_trains():
         t.update(b)
         losses.append(float(t._last_loss))
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_vgg_builder_shapes():
+    from cxxnet_tpu.models import vgg
+    from cxxnet_tpu.nnet.netconfig import NetConfig
+    from cxxnet_tpu.utils.config import parse_config_string
+    for depth, nconv in ((11, 8), (13, 10), (16, 13), (19, 16)):
+        cfg = NetConfig()
+        cfg.configure(parse_config_string(vgg(depth=depth)))
+        convs = [l for l in cfg.layers if l.type_name == "conv"]
+        assert len(convs) == nconv, (depth, len(convs))
+
+
+def test_tiny_vgg_trains():
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import vgg
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    # scale down: 32px input still survives the five 2x pools (32 -> 1)
+    conf = vgg(num_class=4, depth=11).replace("input_shape = 3,224,224",
+                                              "input_shape = 3,32,32")
+    conf = conf.replace("nchannel = 512", "nchannel = 32") \
+               .replace("nchannel = 256", "nchannel = 32") \
+               .replace("nchannel = 128", "nchannel = 16") \
+               .replace("nchannel = 64", "nchannel = 16") \
+               .replace("nhidden = 4096", "nhidden = 64") \
+               .replace("threshold = 0.5", "threshold = 0.0")
+    conf += ("batch_size = 8\ndev = cpu\nupdater = adam\n"
+            "eta = 0.003\nmetric = error\nsilent = 1\n")
+    t = NetTrainer()
+    for k, v in parse_config_string(conf):
+        t.set_param(k, v)
+    t.init_model()
+    rnd = np.random.RandomState(0)
+    b = DataBatch(data=rnd.rand(8, 3, 32, 32).astype(np.float32),
+                  label=rnd.randint(0, 4, (8, 1)).astype(np.float32),
+                  index=np.arange(8, dtype=np.uint32))
+    t.start_round(1)
+    losses = []
+    for _ in range(80):
+        t.update(b)
+        losses.append(float(t._last_loss))
+    assert losses[-1] < losses[0] * 0.8
