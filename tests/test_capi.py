@@ -90,12 +90,17 @@ def test_capi_train_predict(capi):
     assert capi.CXNNetInitModel(net) == 0, capi.CXNGetLastError()
 
     rng = np.random.RandomState(0)
-    for step in range(80):
-        x = rng.rand(16, 1, 1, 6).astype(np.float32)
-        y = (x.reshape(16, 6).sum(1) > 3).astype(np.float32).reshape(16, 1)
-        x[:, 0, 0, 0] += 2.0 * y[:, 0]  # make it clearly separable
-        assert capi.CXNNetUpdateBatch(net, _f32(x), _u64(16, 1, 1, 6), 4,
-                                      _f32(y), _u64(16, 1), 2) == 0
+
+    def train_steps(n):
+        for _ in range(n):
+            xb = rng.rand(16, 1, 1, 6).astype(np.float32)
+            yb = (xb.reshape(16, 6).sum(1) > 3).astype(np.float32) \
+                .reshape(16, 1)
+            xb[:, 0, 0, 0] += 2.0 * yb[:, 0]  # make it clearly separable
+            assert capi.CXNNetUpdateBatch(net, _f32(xb), _u64(16, 1, 1, 6),
+                                          4, _f32(yb), _u64(16, 1), 2) == 0
+
+    train_steps(80)
 
     x = rng.rand(16, 1, 1, 6).astype(np.float32)
     y = (x.reshape(16, 6).sum(1) > 3).astype(np.float32)
@@ -112,13 +117,7 @@ def test_capi_train_predict(capi):
 
     acc = accuracy()
     if acc <= 0.8:  # marginal under parallel-reduction nondeterminism:
-        for _ in range(80):  # keep training rather than flake
-            xb = rng.rand(16, 1, 1, 6).astype(np.float32)
-            yb = (xb.reshape(16, 6).sum(1) > 3).astype(np.float32) \
-                .reshape(16, 1)
-            xb[:, 0, 0, 0] += 2.0 * yb[:, 0]
-            assert capi.CXNNetUpdateBatch(net, _f32(xb), _u64(16, 1, 1, 6),
-                                          4, _f32(yb), _u64(16, 1), 2) == 0
+        train_steps(80)  # keep training rather than flake
         acc = accuracy()
     assert acc > 0.8, acc
     capi.CXNNetFree(net)
