@@ -58,6 +58,13 @@ class IIterator:
         """Return the next element or None at end of epoch."""
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release background resources (threads, pools).  Wrapper iterators
+        forward to their base; safe to call more than once."""
+        base = getattr(self, "base", None)
+        if base is not None:
+            base.close()
+
     def __iter__(self) -> Iterator:
         self.before_first()
         while True:

@@ -261,6 +261,15 @@ class ImageBinIterator(IIterator):
         self._page_pos = 0
         self._done = False
 
+    def close(self):
+        self._gen += 1
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
     def next(self):
         if self._done:
             return None

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import queue
+import sys
 import threading
 from typing import List, Optional
 
@@ -224,6 +225,7 @@ class AugmentIterator(IIterator):
         self.affine = AffineAugmenter()
         self.rnd = np.random.RandomState(_AUG_RAND_MAGIC)
         self._mean: Optional[np.ndarray] = None
+        self._warned_mean_fallback = False
 
     def set_param(self, name, val):
         if self.affine.set_param(name, val):
@@ -301,6 +303,11 @@ class AugmentIterator(IIterator):
                     y0, x0 = (my - dy) // 2, (mx - dx) // 2
                     m = m[:, y0:y0 + dy, x0:x0 + dx]
                 else:  # affine resized past the mean image: channel means
+                    if not self._warned_mean_fallback:
+                        self._warned_mean_fallback = True
+                        print(f"AugmentIterator: mean image {m.shape} smaller "
+                              f"than instance {d.shape}; falling back to "
+                              "per-channel scalar means", file=sys.stderr)
                     m = m.mean(axis=(1, 2), keepdims=True)
             d = d - m
         elif self.mean_value is not None:
@@ -389,6 +396,13 @@ class ThreadBufferIterator(IIterator):
     def next(self):
         assert self._queue is not None, "call before_first() first"
         return self._queue.get()
+
+    def close(self):
+        self._gen += 1
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.base.close()
 
 
 class DenseBufferIterator(IIterator):

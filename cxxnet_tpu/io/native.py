@@ -124,7 +124,10 @@ class NativeImageBinIterator(IIterator):
                          index=index.astype(np.uint32),
                          num_batch_padd=int(padd.value))
 
-    def __del__(self):
+    def close(self) -> None:
         if getattr(self, "_h", None) and self._lib is not None:
             self._lib.CXNIONativeFree(self._h)
             self._h = None
+
+    def __del__(self):
+        self.close()

@@ -91,9 +91,11 @@ class LearnTask:
         elif name == "test_on_server":
             self.test_on_server = int(val)
         elif name == "output_format":
+            # Reference (cxxnet_main.cpp:100-102) treats anything non-"txt"
+            # as binary; keep that contract but warn on unknown spellings.
             if val not in ("txt", "bin"):
-                raise ValueError(
-                    f"output_format must be 'txt' or 'bin', got {val!r}")
+                print(f"output_format={val!r} not 'txt'/'bin'; "
+                      "treating as binary", file=sys.stderr)
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
 
@@ -364,19 +366,24 @@ class LearnTask:
             self.set_param(k, v)
         for k, v in parse_keyval_args(argv[1:]):
             self.set_param(k, v)
-        self.init()
-        if not self.silent:
-            print("initializing end, start working")
-        if self.task in ("train", "finetune"):
-            self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
-        elif self.task == "pred_raw":
-            self.task_predict_raw()
-        elif self.task == "extract":
-            self.task_extract()
-        else:
-            raise ValueError(f"unknown task {self.task!r}")
+        try:
+            self.init()
+            if not self.silent:
+                print("initializing end, start working")
+            if self.task in ("train", "finetune"):
+                self.task_train()
+            elif self.task == "pred":
+                self.task_predict()
+            elif self.task == "pred_raw":
+                self.task_predict_raw()
+            elif self.task == "extract":
+                self.task_extract()
+            else:
+                raise ValueError(f"unknown task {self.task!r}")
+        finally:
+            for it in ([self.itr_train] if self.itr_train else []) + \
+                    self.itr_evals + ([self.itr_pred] if self.itr_pred else []):
+                it.close()
         return 0
 
 

@@ -90,13 +90,14 @@ class MetricRecall(Metric):
         n, k = pred.shape
         assert k >= self.topn, \
             f"rec@{self.topn} meaningless for score list of length {k}"
-        out = np.zeros(n)
-        for i in range(n):
-            order = self._rng.permutation(k)
-            top = order[np.argsort(-pred[i, order], kind="stable")][:self.topn]
-            hits = np.isin(top, label[i].astype(np.int64)).sum()
-            out[i] = hits / label.shape[1]
-        return out
+        # Vectorized: one random secondary key per score reproduces the
+        # reference's shuffle-then-stable-sort tie-break (equal scores are
+        # ordered uniformly at random), without the per-row Python loop.
+        tiebreak = self._rng.random_sample((n, k))
+        top = np.lexsort((tiebreak, -pred), axis=1)[:, :self.topn]
+        lab = label.astype(np.int64)
+        hits = (top[:, :, None] == lab[:, None, :]).any(axis=2).sum(axis=1)
+        return hits / label.shape[1]
 
 
 def create_metric(name: str) -> Metric:

@@ -474,6 +474,12 @@ int CXNRunTask(int argc, const char **argv) {
   if (r == nullptr) return -1;
   long rc = PyLong_AsLong(r);
   Py_DECREF(r);
+  if (rc == -1 && PyErr_Occurred()) {
+    /* run_task returned a non-integer: record and clear the conversion
+       error so no stale exception state leaks into the next API call */
+    set_error_from_python();
+    return -1;
+  }
   return static_cast<int>(rc);
 }
 
