@@ -2,21 +2,39 @@
 
 Reference: ``src/layer/pairtest_layer-inl.hpp`` — config
 ``layer[..] = pairtest-<master>-<slave>`` runs both layers on the same inputs
-each step and reports when outputs/gradients diverge (relative abs error >
-1e-5, :194).  Here the master's outputs drive the graph; the slave runs on
-the same inputs with master-synced parameters and the max relative error is
-recorded into the step's diagnostics dict (returned by the jitted step, so
-checking is free of host sync in the hot loop).  Full gradient-level
-comparison lives in :mod:`cxxnet_tpu.testing` (``diff_layers``), which is the
-idiomatic jax form of the reference's weight-grad visitor comparison.
+each step and reports when they diverge (relative abs error > 1e-5, :194).
+The reference compares four things, all reproduced here:
+
+* forward outputs (``CmpResult(..., "Forward")``, :89-93)
+* propagated input gradients (``Backprop`` nodes_in compare, :110-117)
+* weight gradients after backprop (``Cmp("After-Backprop:grad")``, :108)
+* weights before each forward (``Cmp("Before-Forward:weight")``, :78) —
+  master and slave are updated by the optimizer from their *own* gradients
+  (``ApplyVisitor`` visits both sides, :122-125), so weight drift is the
+  integrated signal that gradients ever differed.
+
+Mechanics in the traced-step world: the master's outputs drive the graph.
+The slave sees the same input *values* but a ``stop_gradient`` on them, and
+its outputs join the master's through a straight-through term
+``m + (s - stop_gradient(s))`` — numerically exactly ``m``, but handing the
+slave's parameters the identical upstream cotangent the master receives, so
+both sides' weight-grads are real and the updater updates both (reference
+behavior).  Input-gradient and weight-gradient comparison runs inside the
+traced forward via a probe-cotangent ``jax.vjp`` of both sides; all
+comparison results are recorded in the step's diagnostics dict (returned by
+the jitted step, so checking costs no host sync in the hot loop).  The
+host-side harness form of the same comparison is
+:func:`cxxnet_tpu.testing.diff_layers`.
 """
 
 from __future__ import annotations
 
-from typing import List
+import dataclasses
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .base import ForwardContext, Layer, Params, Shape4
 
@@ -31,6 +49,54 @@ def relative_error(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     err = jnp.where(denom < 1e-20, 0.0, err)
     # NaN anywhere is an automatic failure (reference checks NaN too)
     return jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.inf, err).max()
+
+
+def tree_relative_error(a, b) -> jnp.ndarray:
+    """Max relative error over matching leaves of two pytrees."""
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if not la:
+        return jnp.float32(0.0)
+    return jnp.stack([relative_error(x, y) for x, y in zip(la, lb)]).max()
+
+
+def sum_losses(ctx: ForwardContext) -> jnp.ndarray:
+    return (sum(ctx.losses[1:], ctx.losses[0]) if ctx.losses
+            else jnp.float32(0.0))
+
+
+def probe_vjp_compare(master, slave, mp, sp, mb, sb, inputs, make_ctx,
+                      probe_key):
+    """Shared core of the After-Backprop comparisons
+    (pairtest_layer-inl.hpp:95-118), used by both the in-graph PairTestLayer
+    and the host-side :func:`cxxnet_tpu.testing.diff_layers`.
+
+    Runs master and slave forward + reverse under ONE probe cotangent (plus
+    the real loss cotangent for loss layers) and returns
+    ``(m_out, s_out, m_loss, s_loss, in_grad_rel_err, wgrad_rel_err)``.
+    ``make_ctx`` must build a fresh ForwardContext with identical rng state
+    on every call so both sides draw the same randomness.
+    """
+    def run(layer, bufs):
+        def f(p, xs):
+            c = make_ctx()
+            outs, _ = layer.forward(p, bufs, xs, c)
+            return [o.astype(jnp.float32) for o in outs], sum_losses(c)
+        return f
+
+    (m_o, m_loss), vjp_m = jax.vjp(run(master, mb), mp, inputs)
+    (s_o, s_loss), vjp_s = jax.vjp(run(slave, sb), sp, inputs)
+    probes = [jax.random.normal(jax.random.fold_in(probe_key, 7331 + i),
+                                o.shape, jnp.float32)
+              for i, o in enumerate(m_o)] if probe_key is not None else \
+             [jnp.ones(o.shape, jnp.float32) for o in m_o]
+    cot = (probes, jnp.float32(1.0))
+    dwm, dxm = vjp_m(cot)
+    dws, dxs = vjp_s(cot)
+    in_err = jnp.stack([relative_error(a, b)
+                        for a, b in zip(dxm, dxs)]).max()
+    w_err = tree_relative_error(dwm, dws) \
+        if jax.tree.leaves(dwm) else jnp.float32(0.0)
+    return m_o, s_o, m_loss, s_loss, in_err, w_err
 
 
 class PairTestLayer(Layer):
@@ -65,19 +131,90 @@ class PairTestLayer(Layer):
         mp = self.master.init_params(key, in_shapes, dtype)
         # master -> slave weight sync at init (reference InitModel:137-141);
         # assumes both sides use the same param tags (true for the zoo).
-        return {"master": mp, "slave": jax.tree.map(lambda x: x, mp)}
+        # Real copies, not aliases: both sides are donated to the jitted
+        # step and an aliased buffer would be donated twice.
+        return {"master": mp, "slave": jax.tree.map(jnp.array, mp)}
 
     def init_buffers(self, in_shapes):
         return {"master": self.master.init_buffers(in_shapes),
                 "slave": self.slave.init_buffers(in_shapes)}
 
+    def _child_ctx(self, ctx: ForwardContext, rng_count: int) -> ForwardContext:
+        """Fresh losses/diagnostics, shared rng stream reset to rng_count so
+        master and slave draw identical randomness (dropout masks etc.)."""
+        return dataclasses.replace(ctx, losses=[], diagnostics={},
+                                   _rng_count=rng_count)
+
     def forward(self, params, buffers, inputs, ctx):
-        m_out, m_buf = self.master.forward(
-            params.get("master", {}), buffers.get("master", {}), inputs, ctx)
-        s_out, s_buf = self.slave.forward(
-            params.get("slave", {}), buffers.get("slave", {}), inputs, ctx)
-        err = jnp.stack([relative_error(a, b)
-                         for a, b in zip(m_out, s_out)]).max()
-        tag = self.name or f"pairtest-{self.master.type_names[0]}-{self.slave.type_names[0]}"
-        ctx.diagnostics[f"{tag}:fwd_rel_err"] = err
-        return m_out, {"master": m_buf, "slave": s_buf}
+        mp = params.get("master", {})
+        sp = params.get("slave", {})
+        mb = buffers.get("master", {})
+        sb = buffers.get("slave", {})
+        base_count = ctx._rng_count
+        tag = self.name or (f"pairtest-{self.master.type_names[0]}"
+                            f"-{self.slave.type_names[0]}")
+        diag: Dict[str, jnp.ndarray] = ctx.diagnostics
+
+        # Before-Forward:weight — drift of optimizer-updated weights (:78)
+        if mp and sp:
+            diag[f"{tag}:weight_rel_err"] = tree_relative_error(mp, sp)
+
+        mctx = self._child_ctx(ctx, base_count)
+        m_out, m_buf = self.master.forward(mp, mb, inputs, mctx)
+        sctx = self._child_ctx(ctx, base_count)
+        s_in = [lax.stop_gradient(x) for x in inputs]
+        s_out, s_buf = self.slave.forward(sp, sb, s_in, sctx)
+        # master drives the graph: its losses/rng-consumption propagate;
+        # the slave's loss terms are measured but NOT trained on
+        ctx.losses.extend(mctx.losses)
+        ctx.diagnostics.update(mctx.diagnostics)
+        ctx._rng_count = mctx._rng_count
+
+        diag[f"{tag}:fwd_rel_err"] = jnp.stack(
+            [relative_error(a, b) for a, b in zip(m_out, s_out)]).max()
+        if mctx.losses or sctx.losses:
+            diag[f"{tag}:loss_rel_err"] = relative_error(
+                sum_losses(mctx), sum_losses(sctx))
+
+        if ctx.train:
+            self._compare_grads(mp, sp, mb, sb, list(inputs), ctx,
+                                base_count, m_out, tag)
+
+        # straight-through: value is exactly m, cotangent reaches the slave's
+        # params so its weight grads are real (reference ApplyVisitor both).
+        # Non-finite slave outputs are zeroed out of the residual — a broken
+        # slave must be *reported* (diagnostics above), not allowed to NaN
+        # the master-driven graph.
+        def st(s):
+            return jnp.where(jnp.isfinite(s), s, 0.0).astype(s.dtype)
+        outs = [m + (st(s) - lax.stop_gradient(st(s)))
+                for m, s in zip(m_out, s_out)]
+        return outs, {"master": m_buf, "slave": s_buf}
+
+    def _compare_grads(self, mp, sp, mb, sb, inputs, ctx, base_count,
+                       m_out, tag):
+        """After-Backprop comparisons (:95-118): input grads + weight grads
+        of both sides under an identical probe cotangent (and the real loss
+        cotangent for loss layers).
+
+        The computation is fenced behind a custom_vjp with zero cotangents:
+        its results are pure diagnostics, and fencing keeps the train step's
+        outer autodiff from trying to linearize the inner ``jax.vjp``
+        (impossible for callback-backed slaves like the torch adapter)."""
+
+        def compute(mp, sp, mb, sb, inputs, rng):
+            _, _, _, _, in_err, w_err = probe_vjp_compare(
+                self.master, self.slave, mp, sp, mb, sb, inputs,
+                lambda: self._child_ctx(ctx, base_count), rng)
+            return in_err, w_err
+
+        fenced = jax.custom_jvp(compute)
+
+        @fenced.defjvp
+        def _zero_jvp(primals, tangents):  # noqa: ANN001
+            out = compute(*primals)
+            return out, jax.tree.map(jnp.zeros_like, out)
+
+        in_err, w_err = fenced(mp, sp, mb, sb, inputs, ctx.rng)
+        ctx.diagnostics[f"{tag}:in_grad_rel_err"] = in_err
+        ctx.diagnostics[f"{tag}:wgrad_rel_err"] = w_err

@@ -268,6 +268,7 @@ class LearnTask:
                     print(f"round {self.start_counter - 1:8d}:"
                           f"[{sample_counter:8d}] {int(now - start)} sec "
                           f"elapsed, {rate:.1f} examples/sec", flush=True)
+                    self._report_diagnostics()
             if tracing:
                 import jax
                 jax.profiler.stop_trace()
@@ -295,6 +296,25 @@ class LearnTask:
             self._save_model()
         if not self.silent:
             print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
+    def _report_diagnostics(self) -> None:
+        """Print step diagnostics (pairtest fwd/bwd/weight relative errors),
+        flagging values over the reference's 1e-5 threshold the way the
+        reference prints exceedances to stderr
+        (pairtest_layer-inl.hpp:190-196)."""
+        diags = getattr(self.net, "_last_diags", None)
+        if not diags:
+            return
+        from .layers.pairtest import PAIRTEST_RTOL
+        parts, bad = [], []
+        for k in sorted(diags):
+            v = float(np.asarray(diags[k]))
+            parts.append(f"{k}={v:.3g}")
+            if k.endswith("_rel_err") and not v <= PAIRTEST_RTOL:
+                bad.append(f"{k}: err={v:g} exceeds {PAIRTEST_RTOL:g}")
+        print("diag: " + " ".join(parts), flush=True)
+        for b in bad:
+            print(b, file=sys.stderr, flush=True)
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \

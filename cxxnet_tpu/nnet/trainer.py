@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..io.data import DataBatch
@@ -124,6 +125,21 @@ class NetTrainer:
         netcfg.configure(self.cfg)
         assert self.batch_size > 0, "batch_size must be set"
         self.netcfg = netcfg
+        self._setup_mesh()
+        self.net = Network(netcfg, self.batch_size, self.dtype)
+        key = jax.random.PRNGKey(self.seed * 100 + 11)
+        self.params = self.net.init_params(key)
+        self.buffers = self.net.init_buffers()
+        self._rng_base = jax.random.PRNGKey(self.seed)
+        self._post_build()
+        if not self.silent:
+            print(self.net.describe())
+
+    def _setup_mesh(self) -> None:
+        """Device selection + mesh build, shared by init_model and
+        load_model (continue/finetune must come up on the same global mesh
+        as a fresh start; the reference restarts its distributed launcher
+        in every worker, cxxnet_main.cpp:135-157)."""
         if jax.process_count() > 1:
             # multi-host: the mesh must span the global device set; local
             # id selection (dev = tpu:0-3) only makes sense single-host
@@ -133,18 +149,7 @@ class NetTrainer:
             self.devices = meshlib.select_devices(self.dev)
         if self.mesh_spec is None and len(self.devices) > 1:
             self.mesh_spec = meshlib.MeshSpec({"data": len(self.devices)})
-        self.mesh = meshlib.build_mesh(
-            self.devices, self.mesh_spec) if (
-                self.mesh_spec or len(self.devices) > 1) else \
-            meshlib.build_mesh(self.devices)
-        self.net = Network(netcfg, self.batch_size, self.dtype)
-        key = jax.random.PRNGKey(self.seed * 100 + 11)
-        self.params = self.net.init_params(key)
-        self.buffers = self.net.init_buffers()
-        self._rng_base = jax.random.PRNGKey(self.seed)
-        self._post_build()
-        if not self.silent:
-            print(self.net.describe())
+        self.mesh = meshlib.build_mesh(self.devices, self.mesh_spec)
 
     def _post_build(self) -> None:
         """Everything derivable from (net, params): updaters, hypers,
@@ -159,16 +164,23 @@ class NetTrainer:
             if conn.owns_params:
                 key_to_layer_index[conn.param_key] = i
         for pkey, group in self.params.items():
-            self.hypers[pkey] = {}
             li = key_to_layer_index.get(pkey)
-            for tag in _group_tags(group):
-                h = UpdaterHyper(tag=tag)
-                for k, v in self.netcfg.defcfg:
-                    h.set_param(k, v)
-                if li is not None:
-                    for k, v in self.netcfg.layercfg[li]:
+
+            def make_hypers(g):
+                out = {}
+                for tag, p in g.items():
+                    if isinstance(p, dict):  # nested group (pairtest sides)
+                        out[tag] = make_hypers(p)
+                        continue
+                    h = UpdaterHyper(tag=tag)
+                    for k, v in self.netcfg.defcfg:
                         h.set_param(k, v)
-                self.hypers[pkey][tag] = h
+                    if li is not None:
+                        for k, v in self.netcfg.layercfg[li]:
+                            h.set_param(k, v)
+                    out[tag] = h
+                return out
+            self.hypers[pkey] = make_hypers(group)
         self.opt_state = _map_group(
             self.params, lambda tag, p: self.updater.init_state(p))
         # eval-node requests (metric[label,node]); "" -> final node
@@ -204,8 +216,8 @@ class NetTrainer:
             return self.repl
 
         self.param_shardings = {
-            pkey: {tag: param_spec(pkey, tag, p.shape)
-                   for tag, p in group.items()}
+            pkey: _map_group({"": group},
+                             lambda tag, p: param_spec(pkey, tag, p.shape))[""]
             for pkey, group in self.params.items()}
         self.opt_shardings = jax.tree.map(
             lambda _: self.repl, self.opt_state)
@@ -250,18 +262,27 @@ class NetTrainer:
             outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
                     for nid in eval_ids}
             return total, (new_buffers, outs, ctx.diagnostics)
+        # NOTE: an lax.optimization_barrier between backprop and the
+        # optimizer (to stop the updater's f32 upcast from fusing into the
+        # weight-grad convs) was measured slightly SLOWER on v5e (54.7ms vs
+        # 53.3ms AlexNet b1024) — XLA's fusion choices here are net wins.
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
     def _apply_update(self, params, opt_state, grads, epoch):
         new_p, new_s = {}, {}
         for pkey, group in params.items():
-            new_p[pkey], new_s[pkey] = {}, {}
-            for tag, p in group.items():
-                q, s = self.updater.apply(
-                    p, grads[pkey][tag], opt_state[pkey][tag],
-                    self.hypers[pkey][tag], epoch)
-                new_p[pkey][tag] = q
-                new_s[pkey][tag] = s
+            def rec(g, gg, ss, hypers):
+                np_, ns_ = {}, {}
+                for tag, p in g.items():
+                    if isinstance(p, dict):  # nested group (pairtest sides)
+                        np_[tag], ns_[tag] = rec(
+                            p, gg[tag], ss[tag], hypers[tag])
+                    else:
+                        np_[tag], ns_[tag] = self.updater.apply(
+                            p, gg[tag], ss[tag], hypers[tag], epoch)
+                return np_, ns_
+            new_p[pkey], new_s[pkey] = rec(
+                group, grads[pkey], opt_state[pkey], self.hypers[pkey])
         return new_p, new_s
 
     def _build_train_step(self):
@@ -556,10 +577,7 @@ class NetTrainer:
                 netcfg.updater_type = v
         self.netcfg = netcfg
         assert self.batch_size > 0, "batch_size must be set before load_model"
-        self.devices = meshlib.select_devices(self.dev)
-        if self.mesh_spec is None and len(self.devices) > 1:
-            self.mesh_spec = meshlib.MeshSpec({"data": len(self.devices)})
-        self.mesh = meshlib.build_mesh(self.devices, self.mesh_spec)
+        self._setup_mesh()
         self.net = Network(netcfg, self.batch_size, self.dtype)
         self.params = jax.tree.map(jnp.asarray, params)
         self.buffers = jax.tree.map(jnp.asarray, buffers)
@@ -594,10 +612,16 @@ class NetTrainer:
     # ------------------------------------------------------------- checking
     def check_weight_consistency(self) -> float:
         """Replica-consistency check, the ``test_on_server`` equivalent
-        (async_updater-inl.hpp:144-154): max abs difference of any param
-        leaf across its replicas. 0.0 means all replicas agree."""
+        (async_updater-inl.hpp:144-154): max abs difference of any param,
+        optimizer-state, or buffer leaf across its replicas (the reference's
+        CheckWeight_ covered the thing being updated; here momentum/adam
+        state and batch-norm running stats are replicated update targets
+        too).  0.0 means all replicas agree.  ZeRO-sharded optimizer leaves
+        hold distinct slices per device — the slice-index grouping below
+        compares only true replicas."""
         worst = 0.0
-        for leaf in jax.tree.leaves(self.params):
+        for leaf in jax.tree.leaves((self.params, self.opt_state,
+                                     self.buffers)):
             shards = getattr(leaf, "addressable_shards", None)
             if not shards or len(shards) < 2:
                 continue
@@ -616,10 +640,10 @@ class NetTrainer:
         return worst
 
 
-def _group_tags(group: Dict) -> List[str]:
-    return list(group.keys())
-
-
 def _map_group(params, fn):
-    return {pkey: {tag: fn(tag, p) for tag, p in group.items()}
-            for pkey, group in params.items()}
+    """Apply fn(tag, leaf) over param groups, recursing through nested
+    sub-groups (pairtest layers hold {"master": {...}, "slave": {...}})."""
+    def rec(g):
+        return {tag: rec(p) if isinstance(p, dict) else fn(tag, p)
+                for tag, p in g.items()}
+    return {pkey: rec(group) for pkey, group in params.items()}

@@ -130,10 +130,17 @@ def _pool_padding(h: int, w: int, kh: int, kw: int, stride: int,
     return (pad_y, pad_y + tail_h), (pad_x, pad_x + tail_w)
 
 
-def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
-               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
-    # NOTE: backward is XLA's select-and-scatter; measured faster on TPU
-    # than both a strided-scatter and a pad-and-add hand-written VJP
+# max-pool backward dispatch: "sas" (default) uses XLA's select-and-scatter
+# (the lax.reduce_window VJP) — gradient goes to ONE maximum per window.
+# "eq" opts into the equality-mask VJP below: exact mshadow unpool
+# semantics (ties get gradient at EVERY maximum), but ~1.8x slower on v5e
+# (95.6ms vs 53.3ms AlexNet b1024 step) because the kx*ky dilate-and-add
+# passes materialize instead of fusing.
+_POOL_BWD = os.environ.get("CXXNET_POOL_BWD", "sas")
+
+
+def _max_pool_raw(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+                  pad_y: int, pad_x: int) -> jnp.ndarray:
     pad_h, pad_w = _pool_padding(x.shape[2], x.shape[3], ksize_y, ksize_x,
                                  stride, pad_y, pad_x)
     return lax.reduce_window(
@@ -141,6 +148,62 @@ def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
         window_dimensions=(1, 1, ksize_y, ksize_x),
         window_strides=(1, 1, stride, stride),
         padding=((0, 0), (0, 0), pad_h, pad_w))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _max_pool_eq(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+                 pad_y: int, pad_x: int) -> jnp.ndarray:
+    return _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
+
+
+def _max_pool_eq_fwd(x, ksize_y, ksize_x, stride, pad_y, pad_x):
+    y = _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
+    return y, (x, y)
+
+
+def _max_pool_eq_bwd(ksize_y, ksize_x, stride, pad_y, pad_x, res, dy):
+    """Equality-mask max-pool backward (mshadow ``unpool<red::maximum>``
+    semantics: every input equal to its window's max receives the window's
+    gradient — ties propagate to ALL maxima, unlike XLA select-and-scatter
+    which picks one).  Measured ~1.8x SLOWER than select-and-scatter in a
+    full AlexNet step on v5e (see _POOL_BWD above) — the kx*ky
+    dilate-and-add passes materialize instead of fusing — so this is the
+    exact-semantics opt-in, not the fast path."""
+    x, y = res
+    n, c, h, w = x.shape
+    oh, ow = y.shape[2], y.shape[3]
+    s = stride
+    (plo_h, phi_h), (plo_w, phi_w) = _pool_padding(
+        h, w, ksize_y, ksize_x, stride, pad_y, pad_x)
+    H, W = h + plo_h + phi_h, w + plo_w + phi_w
+    xp = jnp.pad(x, ((0, 0), (0, 0), (plo_h, phi_h), (plo_w, phi_w)),
+                 constant_values=-jnp.inf)
+    ext_h, ext_w = (oh - 1) * s + 1, (ow - 1) * s + 1
+    acc = None
+    zero = jnp.zeros((), x.dtype)
+    for i in range(ksize_y):
+        for j in range(ksize_x):
+            xs = lax.slice(xp, (0, 0, i, j),
+                           (n, c, i + ext_h, j + ext_w), (1, 1, s, s))
+            contrib = jnp.where(xs == y, dy, zero)
+            # dilate back onto the padded input grid at offset (i, j)
+            placed = lax.pad(
+                contrib, zero,
+                ((0, 0, 0), (0, 0, 0),
+                 (i, H - i - ext_h, s - 1), (j, W - j - ext_w, s - 1)))
+            acc = placed if acc is None else acc + placed
+    dx = lax.slice(acc, (0, 0, plo_h, plo_w), (n, c, plo_h + h, plo_w + w))
+    return (dx,)
+
+
+_max_pool_eq.defvjp(_max_pool_eq_fwd, _max_pool_eq_bwd)
+
+
+def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    if _POOL_BWD == "eq":
+        return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
+    return _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
 
 
 def sum_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,

@@ -1,0 +1,71 @@
+"""Differential-testing harness (host-side form of the PairTest layer).
+
+The reference validates new layer implementations by wiring
+``layer[..] = pairtest-<master>-<slave>`` into a config
+(``src/layer/pairtest_layer-inl.hpp``); :func:`diff_layers` is the direct
+programmatic equivalent for tests and notebooks: build both layers, sync
+weights master->slave, run forward and a probe-cotangent backward through
+each, and return the relative errors of outputs, input gradients, and
+weight gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers.base import ForwardContext, LabelInfo, Layer, Shape4
+from ..layers.pairtest import (PAIRTEST_RTOL, probe_vjp_compare,
+                               relative_error)
+
+__all__ = ["diff_layers", "PAIRTEST_RTOL"]
+
+
+def diff_layers(master: Layer, slave: Layer, in_shapes: Sequence[Shape4],
+                *, key: Optional[jax.Array] = None, dtype=jnp.float32,
+                train: bool = True,
+                labels: Optional[Dict[str, np.ndarray]] = None,
+                loss_scale: float = 1.0) -> Dict[str, float]:
+    """Compare two layer implementations on random inputs.
+
+    Returns ``{"fwd_rel_err", "in_grad_rel_err", "wgrad_rel_err",
+    "loss_rel_err"}`` (the latter two 0.0 when the layers own no params /
+    emit no loss).  Mirrors pairtest_layer-inl.hpp:75-118: outputs, input
+    grads and weight grads under one shared cotangent, with slave weights
+    synced from the master first (:137-141).
+    """
+    key = jax.random.PRNGKey(0) if key is None else key
+    in_shapes = [tuple(s) for s in in_shapes]
+    kin, kparam, kprobe, krng = jax.random.split(key, 4)
+    inputs = [jax.random.normal(jax.random.fold_in(kin, i), s, dtype)
+              for i, s in enumerate(in_shapes)]
+    m_shapes = master.infer_shapes(list(in_shapes))
+    s_shapes = slave.infer_shapes(list(in_shapes))
+    assert m_shapes == s_shapes, \
+        f"diff_layers: output shapes differ: {m_shapes} vs {s_shapes}"
+    mp = master.init_params(kparam, list(in_shapes), dtype)
+    sp = jax.tree.map(jnp.array, mp)  # master -> slave sync
+    mb = master.init_buffers(list(in_shapes))
+    sb = slave.init_buffers(list(in_shapes))
+
+    label_info = None
+    if labels is not None:
+        label_info = LabelInfo(fields={k: jnp.asarray(v, jnp.float32)
+                                       for k, v in labels.items()})
+
+    def ctx() -> ForwardContext:
+        return ForwardContext(train=train, rng=krng, labels=label_info,
+                              loss_scale=loss_scale)
+
+    m_out, s_out, m_loss, s_loss, in_err, w_err = probe_vjp_compare(
+        master, slave, mp, sp, mb, sb, inputs, ctx, kprobe)
+    return {
+        "fwd_rel_err": float(jnp.stack(
+            [relative_error(a, b) for a, b in zip(m_out, s_out)]).max()),
+        "loss_rel_err": float(relative_error(m_loss, s_loss)),
+        "in_grad_rel_err": float(in_err),
+        "wgrad_rel_err": float(w_err),
+    }

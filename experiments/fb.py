@@ -1,0 +1,50 @@
+"""Fast full-step bench for iterating on trainer/op changes.
+
+python experiments/fb.py [batch]  -> prints AlexNet step ms + imgs/sec + MFU.
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    scan_len, trials = 10, 2
+    from __graft_entry__ import ALEXNET_NET, _make_trainer
+    from bench import conv_flops_per_image, PEAK_FLOPS
+    t = _make_trainer(ALEXNET_NET, batch, "tpu",
+                      extra=[("dtype", "bfloat16"), ("eval_train", "0")])
+    rnd = np.random.RandomState(0)
+    datas = jnp.asarray(
+        rnd.rand(scan_len, batch, 3, 227, 227).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    labels = jnp.asarray(
+        rnd.randint(0, 1000, (scan_len, batch, 1)).astype(np.float32))
+    t.start_round(1)
+    c0 = time.perf_counter()
+    np.asarray(t.update_many(datas, labels))
+    print(f"compile+warm: {time.perf_counter()-c0:.1f}s", file=sys.stderr)
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        losses = t.update_many(datas, labels)
+    np.asarray(losses)
+    dt = time.perf_counter() - t0
+    steps = trials * scan_len
+    step_ms = dt / steps * 1e3
+    ips = batch * steps / dt
+    flops_fwd = conv_flops_per_image(t.net)
+    dev = jax.devices()[0].device_kind
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev), 197e12)
+    mfu = 3.0 * flops_fwd * ips / peak
+    print(f"b{batch} step={step_ms:.2f}ms imgs/sec={ips:.0f} "
+          f"MFU={mfu*100:.1f}% loss[-1]={float(np.asarray(losses)[-1]):.3f}")
+
+
+if __name__ == "__main__":
+    main()

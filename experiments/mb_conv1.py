@@ -1,0 +1,49 @@
+"""Conv1 wgrad/dgrad strategies, timed in-device-loop (see mb_util)."""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/experiments")
+from mb_util import bench_op, bench_empty  # noqa: E402
+from cxxnet_tpu.ops.nn import conv2d, conv2d_s2d  # noqa: E402
+
+B = 1024
+
+
+def main():
+    rnd = np.random.RandomState(0)
+    x = jnp.asarray(rnd.rand(B, 3, 227, 227), jnp.bfloat16)
+    w = jnp.asarray(rnd.rand(96, 3, 11, 11), jnp.bfloat16)
+    dy = jnp.asarray(rnd.rand(B, 96, 55, 55), jnp.bfloat16)
+
+    print(f"harness floor:        {bench_empty():7.2f} ms")
+    print(f"fwd conv:             {bench_op(lambda x, w: conv2d(x, w, stride=4), x, w):7.2f} ms")
+
+    def wg(conv):
+        def f(x, w, dy):
+            _, vjp = jax.vjp(lambda w: conv(x, w), w)
+            return vjp(dy)[0]
+        return f
+
+    def dg(conv):
+        def f(x, w, dy):
+            _, vjp = jax.vjp(lambda x: conv(x, w), x)
+            return vjp(dy)[0]
+        return f
+
+    c_def = lambda x, w: conv2d(x, w, stride=4)  # noqa: E731
+    c_s2d = lambda x, w: conv2d_s2d(x, w, stride=4)  # noqa: E731
+    print(f"wgrad default:        {bench_op(wg(c_def), x, w, dy):7.2f} ms")
+    print(f"wgrad s2d:            {bench_op(wg(c_s2d), x, w, dy):7.2f} ms")
+    print(f"dgrad default:        {bench_op(dg(c_def), x, w, dy):7.2f} ms")
+    print(f"dgrad s2d:            {bench_op(dg(c_s2d), x, w, dy):7.2f} ms")
+
+    flops = 2.0 * B * 96 * 55 * 55 * 3 * 11 * 11
+    print(f"one pass = {flops/1e9:.1f} GFLOP = {flops/197e12*1e3:.2f} ms @peak")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Trace the AlexNet train step and print the per-op time breakdown.
+
+Usage: python experiments/profile_step.py [batch] [config]
+Writes the trace under /tmp/cxprof and parses the device plane of the
+XSpace proto directly (tensorboard_plugin_profile is available but its
+tool pipeline is heavier than needed).
+"""
+import glob
+import os
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def run_traced(tracedir, batch=1024, scan_len=6):
+    from __graft_entry__ import ALEXNET_NET, _make_trainer
+    t = _make_trainer(ALEXNET_NET, batch, "tpu",
+                      extra=[("dtype", "bfloat16"), ("eval_train", "0")])
+    rnd = np.random.RandomState(0)
+    datas = jnp.asarray(
+        rnd.rand(scan_len, batch, 3, 227, 227).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    labels = jnp.asarray(
+        rnd.randint(0, 1000, (scan_len, batch, 1)).astype(np.float32))
+    t.start_round(1)
+    np.asarray(t.update_many(datas, labels))  # compile+warm
+    jax.profiler.start_trace(tracedir)
+    np.asarray(t.update_many(datas, labels))
+    jax.profiler.stop_trace()
+    return scan_len
+
+
+def parse(tracedir, nsteps):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = glob.glob(os.path.join(tracedir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert paths, f"no xplane under {tracedir}"
+    xs = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as f:
+        xs.ParseFromString(f.read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        print(f"=== plane: {plane.name}")
+        ev_names = plane.event_metadata
+        tot = defaultdict(float)
+        cnt = defaultdict(int)
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and "Steps" not in line.name \
+                    and "XLA Modules" not in line.name:
+                continue
+            for ev in line.events:
+                name = ev_names[ev.metadata_id].name
+                dur = ev.duration_ps / 1e9  # ms
+                if "XLA Modules" in line.name:
+                    print(f"  module {name}: {dur:.2f} ms total "
+                          f"({dur/nsteps:.2f}/step)")
+                elif "XLA Ops" in line.name:
+                    tot[name] += dur
+                    cnt[name] += 1
+        if tot:
+            print(f"  --- top ops (over {nsteps} steps, ms/step):")
+            items = sorted(tot.items(), key=lambda kv: -kv[1])
+            s = sum(tot.values())
+            acc = 0.0
+            for name, d in items[:40]:
+                acc += d
+                print(f"  {d/nsteps:8.3f}  {cnt[name]//nsteps:3d}x  "
+                      f"{name[:100]}")
+            print(f"  total device time: {s/nsteps:.2f} ms/step")
+
+
+if __name__ == "__main__":
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    tracedir = f"/tmp/cxprof_b{batch}"
+    os.system(f"rm -rf {tracedir}")
+    n = run_traced(tracedir, batch)
+    parse(tracedir, n)

@@ -46,8 +46,7 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_training_identical_weights(tmp_path):
-    # synthetic mnist idx files
+def _write_synth_data(tmp_path):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     import make_synth_mnist as sm
     rnd = np.random.RandomState(0)
@@ -57,6 +56,52 @@ def test_two_process_training_identical_weights(tmp_path):
                      for l in labels])
     sm.write_idx_images(str(tmp_path / "img.gz"), imgs)
     sm.write_idx_labels(str(tmp_path / "lbl.gz"), labels)
+
+
+def _run_workers(conf, tmp_path, extra_args=()):
+    """Launch two coordinated worker processes; return their outputs."""
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(CXN_COORDINATOR=f"127.0.0.1:{port}",
+                   CXN_NUM_PROC="2", CXN_PROC_RANK=str(rank))
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER, ROOT, str(conf),
+             f"model_dir={tmp_path}/m{rank}", *extra_args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    return outs
+
+
+def _assert_checkpoints_identical(tmp_path, name, min_arrays=4):
+    w0 = np.load(tmp_path / "m0" / name, allow_pickle=True)
+    w1 = np.load(tmp_path / "m1" / name, allow_pickle=True)
+    assert sorted(w0.files) == sorted(w1.files)
+    n_arrays = 0
+    for k in w0.files:
+        if k == "__header__":
+            # legitimately differs: captured config embeds the per-worker
+            # model_dir and dist_worker_rank
+            continue
+        a, b = w0[k], w1[k]
+        if a.dtype == object:
+            continue
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"replica weight {k} diverged across processes")
+        n_arrays += 1
+    assert n_arrays >= min_arrays
+
+
+def test_two_process_training_identical_weights(tmp_path):
+    _write_synth_data(tmp_path)
 
     conf = tmp_path / "dist.conf"
     conf.write_text(f"""
@@ -79,45 +124,26 @@ batch_size = 16
 eta = 0.1
 num_round = 3
 metric = error
-save_model = 3
+save_model = 1
 silent = 1
 """)
-    port = _free_port()
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update(CXN_COORDINATOR=f"127.0.0.1:{port}",
-                   CXN_NUM_PROC="2", CXN_PROC_RANK=str(rank))
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER, ROOT, str(conf),
-             f"model_dir={tmp_path}/m{rank}"],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    outs = _run_workers(conf, tmp_path)
     # npz container metadata embeds timestamps; compare the tensors
-    w0 = np.load(tmp_path / "m0" / "0003.model", allow_pickle=True)
-    w1 = np.load(tmp_path / "m1" / "0003.model", allow_pickle=True)
-    assert sorted(w0.files) == sorted(w1.files)
-    n_arrays = 0
-    for k in w0.files:
-        if k == "__header__":
-            # legitimately differs: captured config embeds the per-worker
-            # model_dir and dist_worker_rank
-            continue
-        a, b = w0[k], w1[k]
-        if a.dtype == object:
-            continue
-        np.testing.assert_array_equal(
-            a, b, err_msg=f"replica weight {k} diverged across processes")
-        n_arrays += 1
-    assert n_arrays >= 4  # fc1/fc2 wmat+bias at least
+    _assert_checkpoints_identical(tmp_path, "0003.model")
     # both workers evaluated the same global model: identical metric lines
     m0 = [l for l in outs[0].splitlines() if "train-error" in l]
     m1 = [l for l in outs[1].splitlines() if "train-error" in l]
     assert m0 and m0 == m1, f"metric lines diverged: {m0} vs {m1}"
+
+    # ---- kill-and-continue: restart both workers with continue=1; the
+    # resumed run must come up on the global mesh (load_model goes through
+    # the same mesh bring-up as init_model) and end bit-identical across
+    # processes (reference restart flow, cxxnet_main.cpp:135-157)
+    outs2 = _run_workers(conf, tmp_path, ("continue=1", "num_round=5"))
+    assert (tmp_path / "m0" / "0005.model").exists(), outs2[0][-2000:]
+    _assert_checkpoints_identical(tmp_path, "0005.model")
+    m0 = [l for l in outs2[0].splitlines() if "train-error" in l]
+    m1 = [l for l in outs2[1].splitlines() if "train-error" in l]
+    assert m0 and m0 == m1, f"continue metric lines diverged: {m0} vs {m1}"
+    # the continued run really did load the round-3 checkpoint
+    assert any("[4]" in l for l in m0), m0

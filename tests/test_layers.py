@@ -382,6 +382,79 @@ def test_pairtest_detects_divergence():
     assert float(ctx.diagnostics[key]) > 1e-3
 
 
+def test_pairtest_gradient_comparison():
+    """Train-mode pairtest records input-grad + weight-grad relative errors
+    (reference After-Backprop comparisons, pairtest_layer-inl.hpp:95-118)."""
+    x = rand4(2, 3, 8, 8)
+    layer = create_layer("pairtest-conv-conv")
+    layer.set_param("nchannel", "4")
+    layer.set_param("kernel_size", "3")
+    shapes = [tuple(x.shape)]
+    layer.infer_shapes(shapes)
+    params = layer.init_params(jax.random.PRNGKey(0), shapes)
+    bufs = layer.init_buffers(shapes)
+    ctx = ForwardContext(train=True, rng=jax.random.PRNGKey(3))
+    outs, _ = layer.forward(params, bufs, [jnp.asarray(x)], ctx)
+    d = ctx.diagnostics
+    for suffix in ("fwd_rel_err", "in_grad_rel_err", "wgrad_rel_err",
+                   "weight_rel_err"):
+        (v,) = [d[k] for k in d if k.endswith(suffix)]
+        assert float(v) < 1e-5, (suffix, float(v))
+
+
+def test_pairtest_catches_broken_backward():
+    """A deliberately-broken slave (different pad => different gradient
+    geometry is caught at infer; here: different stride-compatible layer
+    with same shapes but different math) trips the gradient comparison."""
+    x = rand4(2, 3, 8, 8)
+    layer = create_layer("pairtest-relu-sigmoid")
+    shapes = [tuple(x.shape)]
+    layer.infer_shapes(shapes)
+    ctx = ForwardContext(train=True, rng=jax.random.PRNGKey(3))
+    layer.forward({}, {}, [jnp.asarray(x)], ctx)
+    d = ctx.diagnostics
+    (fwd,) = [d[k] for k in d if k.endswith("fwd_rel_err")]
+    (bwd,) = [d[k] for k in d if k.endswith("in_grad_rel_err")]
+    assert float(fwd) > 1e-3
+    assert float(bwd) > 1e-3
+
+
+def test_pairtest_straight_through_is_master():
+    """Pairtest output values must be exactly the master's (slave joins
+    only through a zero-valued straight-through term)."""
+    x = rand4(2, 3, 6, 6)
+    layer = create_layer("pairtest-max_pooling-avg_pooling")
+    layer.set_param("kernel_size", "2")
+    layer.set_param("stride", "2")
+    layer.infer_shapes([tuple(x.shape)])
+    ctx = ForwardContext(train=True, rng=jax.random.PRNGKey(0))
+    (out,), _ = layer.forward({}, {}, [jnp.asarray(x)], ctx)
+    from cxxnet_tpu.ops import nn as N
+    ref = N.max_pool2d(jnp.asarray(x), 2, 2, 2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_diff_layers_harness():
+    """cxxnet_tpu.testing.diff_layers: clean pair ~0 err; broken pair big."""
+    from cxxnet_tpu.testing import diff_layers
+    a = create_layer("conv")
+    b = create_layer("conv")
+    for l in (a, b):
+        l.set_param("nchannel", "4")
+        l.set_param("kernel_size", "3")
+        l.set_param("pad", "1")
+    d = diff_layers(a, b, [(2, 3, 8, 8)])
+    assert d["fwd_rel_err"] < 1e-5
+    assert d["in_grad_rel_err"] < 1e-5
+    assert d["wgrad_rel_err"] < 1e-5
+
+    broken = create_layer("relu")
+    ok = create_layer("tanh")
+    d = diff_layers(ok, broken, [(2, 3, 8, 8)])
+    assert d["fwd_rel_err"] > 1e-3
+    assert d["in_grad_rel_err"] > 1e-3
+
+
 def test_conv2d_s2d_matches_conv2d():
     """Space-to-depth lowering is numerically the same conv (fwd + grads)."""
     import jax

@@ -75,6 +75,54 @@ def test_activations_vs_torch():
         _run_pair(op, op, rand4(2, 3, 4, 4), {})
 
 
+PAIRTEST_CONF = """
+netconfig=start
+layer[+1:pt] = pairtest-conv-torch:pt
+  slave:op = conv
+  nchannel = 4
+  kernel_size = 3
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,7,7
+batch_size = 8
+dev = cpu
+eta = 0.01
+metric = error
+"""
+
+
+def test_pairtest_config_driven_training():
+    """The reference's key validation flow: a config embedding
+    pairtest-conv-torch trains, and every step's diagnostics carry
+    fwd/in-grad/wgrad relative errors that stay ~0 for a faithful slave
+    (pairtest_layer-inl.hpp:75-118)."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    from cxxnet_tpu.io.data import DataBatch
+    t = NetTrainer()
+    for k, v in parse_config_string(PAIRTEST_CONF):
+        t.set_param(k, v)
+    t.init_model()
+    rnd = np.random.RandomState(0)
+    for step in range(3):
+        batch = DataBatch(
+            data=rnd.rand(8, 3, 7, 7).astype(np.float32),
+            label=rnd.randint(0, 3, (8, 1)).astype(np.float32),
+            index=np.arange(8, dtype=np.uint32))
+        t.update(batch)
+        d = {k: float(np.asarray(v)) for k, v in t._last_diags.items()}
+        for suffix in ("fwd_rel_err", "in_grad_rel_err", "wgrad_rel_err",
+                       "weight_rel_err"):
+            (v,) = [d[k] for k in d if k.endswith(suffix)]
+            assert v < 5e-4, (step, suffix, v, d)
+
+
 def test_pairtest_conv_torch_in_net():
     """pairtest-conv-torch reports ~zero forward divergence inside a net
     forward (the reference's config-level differential harness)."""
