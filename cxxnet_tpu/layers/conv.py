@@ -73,6 +73,11 @@ class ConvolutionLayer(Layer):
         self.check_n_inputs(inputs, 1)
         p = self.param
         x = inputs[0]
+        if ("bias" in params and not self.space_to_depth
+                and N.use_fast_wgrad(x.shape[1], p.stride, p.num_group)):
+            out = N.conv_bias_fast(x, params["wmat"], params["bias"],
+                                   p.stride, p.pad_y, p.pad_x)
+            return [out], buffers
         if self.space_to_depth and p.stride > 1 and p.num_group == 1:
             out = N.conv2d_s2d(x, params["wmat"], stride=p.stride,
                                pad_y=p.pad_y, pad_x=p.pad_x)
@@ -146,19 +151,28 @@ class AvgPoolingLayer(_PoolingBase):
 
 
 class InsanityPoolingLayer(_PoolingBase):
-    """Stochastic-neighborhood max pooling (insanity_pooling_layer-inl.hpp).
+    """Stochastic-neighborhood max pooling, exact reference semantics
+    (insanity_pooling_layer-inl.hpp:13-49 fwd, :150-210 bwd).
 
-    The reference defines custom mshadow expressions that, at train time, pick
-    the max over a *randomly jittered* window anchor; at eval it behaves as
-    plain max pooling.  We reproduce the train-time stochasticity by jittering
-    each output window's anchor by a per-window random offset in
-    [-jitter, +jitter] (bounded by the pad), which preserves the layer's
-    regularization character; eval is exact max pooling.  This is also the
-    designated example of the custom-kernel extension slot (a Pallas kernel
-    can replace `_stochastic_pool`).
+    Train time: every input position's read is randomly redirected to
+    itself or one of its 4 neighbors (bands of a uniform mask, widths
+    (1-keep)/4, edge-clamped), and max pooling runs over the jittered
+    image; the backward propagates to every tied position of the jittered
+    image at the window position (see ops.nn.insanity_max_pool).  Eval is
+    plain max pooling.  ``keep`` config (reference SetParam "keep",
+    default 1.0 = no jitter).
     """
 
     type_names = ("insanity_max_pooling",)
+
+    def __init__(self):
+        super().__init__()
+        self.p_keep = 1.0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "keep":
+            self.p_keep = float(val)
+        super().set_param(name, val)
 
     def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
         assert self.param.pad_y == 0 and self.param.pad_x == 0, \
@@ -170,31 +184,11 @@ class InsanityPoolingLayer(_PoolingBase):
         p = self.param
         x = inputs[0]
         if not ctx.train:
-            return [N.max_pool2d(x, p.kernel_height, p.kernel_width, p.stride)], buffers
-        n, c, h, w = x.shape
-        oh = N.pool_out_size(h, p.kernel_height, p.stride)
-        ow = N.pool_out_size(w, p.kernel_width, p.stride)
-        # random anchor jitter of +/-1 per output position, shared over channels
-        key = ctx.next_rng()
-        jy = jax.random.randint(key, (n, 1, oh, ow), -1, 2)
-        jx = jax.random.randint(jax.random.fold_in(key, 1), (n, 1, oh, ow), -1, 2)
-        ys = jnp.arange(oh)[None, None, :, None] * p.stride
-        xs = jnp.arange(ow)[None, None, None, :] * p.stride
-        y0 = jnp.clip(ys + jy, 0, h - p.kernel_height)
-        x0 = jnp.clip(xs + jx, 0, w - p.kernel_width)
-        # gather the jittered windows and reduce: build index grids
-        wy = jnp.arange(p.kernel_height)
-        wx = jnp.arange(p.kernel_width)
-        yi = y0[..., None, None] + wy[None, None, None, None, :, None]
-        xi = x0[..., None, None] + wx[None, None, None, None, None, :]
-        yi = jnp.broadcast_to(yi, (n, 1, oh, ow, p.kernel_height, p.kernel_width))
-        xi = jnp.broadcast_to(xi, (n, 1, oh, ow, p.kernel_height, p.kernel_width))
-        # x[n, c, yi, xi] via take_along_axis-style advanced indexing
-        bi = jnp.arange(n).reshape(n, 1, 1, 1, 1, 1)
-        ci = jnp.arange(c).reshape(1, c, 1, 1, 1, 1)
-        vals = x[bi, ci, yi, xi]
-        out = vals.max(axis=(-1, -2))
-        return [out], buffers
+            return [N.max_pool2d(x, p.kernel_height, p.kernel_width,
+                                 p.stride)], buffers
+        mask = jax.random.uniform(ctx.next_rng(), x.shape, jnp.float32)
+        return [N.insanity_max_pool(x, mask, p.kernel_height, p.kernel_width,
+                                    p.stride, self.p_keep)], buffers
 
 
 class LRNLayer(Layer):

@@ -39,6 +39,7 @@ class LearnTask:
         self.max_round = 2147483647
         self.silent = 0
         self.test_io = 0
+        self.multi_step = 0
         self.extract_node_name = ""
         self.prof_dir = ""
         self.test_on_server = 0
@@ -82,6 +83,8 @@ class LearnTask:
             self.device = val
         elif name == "test_io":
             self.test_io = int(val)
+        elif name == "multi_step":
+            self.multi_step = int(val)
         elif name == "extract_node_name":
             self.extract_node_name = val
         elif name == "eval_train":
@@ -253,22 +256,47 @@ class LearnTask:
             n_mark = 0
             self.net.start_round(self.start_counter)
             self.itr_train.before_first()
-            while True:
+            # multi_step > 1 groups K batches into ONE device dispatch
+            # (an on-device lax.scan), the TPU equivalent of the
+            # reference's ThreadBuffer keeping the GPU queue full
+            # (iter_batch_proc-inl.hpp:136-224); train metrics stay exact
+            # (outputs come back stacked, one D2H per group)
+            group_n = self.multi_step if (
+                self.multi_step > 1 and self.test_io == 0
+                and self.net.update_period == 1) else 1
+            pending = []
+            done = False
+            while not done:
                 batch = self.itr_train.next()
                 if batch is None:
-                    break
+                    done = True
+                else:
+                    pending.append(batch)
+                flush = done or len(pending) >= group_n
+                if not flush or not pending:
+                    continue
+                group, pending = pending, []
                 if self.test_io == 0:
-                    self.net.update(batch)
-                sample_counter += 1
-                n_mark += batch.batch_size - batch.num_batch_padd
-                if sample_counter % self.print_step == 0 and not self.silent:
-                    now = time.time()
-                    rate = n_mark / max(now - t_mark, 1e-9)
-                    t_mark, n_mark = now, 0
-                    print(f"round {self.start_counter - 1:8d}:"
-                          f"[{sample_counter:8d}] {int(now - start)} sec "
-                          f"elapsed, {rate:.1f} examples/sec", flush=True)
-                    self._report_diagnostics()
+                    # extra-data inputs aren't threaded through the scan
+                    # path; fall back to per-batch dispatch for them
+                    if len(group) > 1 and not any(b.extra_data
+                                                  for b in group):
+                        self._update_group(group)
+                    else:
+                        for b in group:
+                            self.net.update(b)
+                for b in group:
+                    sample_counter += 1
+                    n_mark += b.batch_size - b.num_batch_padd
+                    if sample_counter % self.print_step == 0 \
+                            and not self.silent:
+                        now = time.time()
+                        rate = n_mark / max(now - t_mark, 1e-9)
+                        t_mark, n_mark = now, 0
+                        print(f"round {self.start_counter - 1:8d}:"
+                              f"[{sample_counter:8d}] {int(now - start)} sec "
+                              f"elapsed, {rate:.1f} examples/sec", flush=True)
+                        self._report_diagnostics()
             if tracing:
                 import jax
                 jax.profiler.stop_trace()
@@ -296,6 +324,24 @@ class LearnTask:
             self._save_model()
         if not self.silent:
             print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
+    def _update_group(self, group) -> None:
+        """Dispatch a group of batches as one on-device multi-step scan,
+        accumulating the train metric from the stacked eval outputs."""
+        net = self.net
+        datas = np.stack([b.data for b in group])
+        labels = np.stack([b.label for b in group])
+        want_outs = bool(net.eval_train and net.train_metric.evals)
+        if want_outs:
+            _, outs = net.update_many(datas, labels, with_outs=True)
+            outs = {nid: np.asarray(v) for nid, v in outs.items()}
+            for j, b in enumerate(group):
+                preds = [outs[nid][j] for nid in net.eval_node_ids]
+                lab = {name: b.label[:, a:bb]
+                       for name, a, bb in net._label_fields}
+                net.train_metric.add_eval(preds, lab)
+        else:
+            net.update_many(datas, labels)
 
     def _report_diagnostics(self) -> None:
         """Print step diagnostics (pairtest fwd/bwd/weight relative errors),

@@ -341,25 +341,29 @@ class NetTrainer:
                        out_shardings=shardings_out,
                        donate_argnums=(0, 1, 2))
 
-    def _build_multi_step(self, nsteps: int):
+    def _build_multi_step(self, nsteps: int, with_outs: bool = False):
         """One jitted ``lax.scan`` over ``nsteps`` sequential updates.
 
         The parameter/optimizer trajectory is identical to ``nsteps`` calls
         of :meth:`update` (period 1), including the per-step PRNG keys
         (``fold_in(rng_base, sample_counter)``, matching update()'s
-        increment-then-fold).  What it does NOT do: accumulate the train
-        metric or populate ``_last_outs``/``_last_diags`` — it is the
-        throughput path; metrics need per-step host copies.  A single
-        dispatch amortizes host->device launch latency across the scan: the
-        reference hides per-batch launch cost with its ThreadBuffer prefetch
-        thread (iter_batch_proc-inl.hpp:136-224); on TPU the idiomatic
-        equivalent is keeping the loop on device.
+        increment-then-fold).  A single dispatch amortizes host->device
+        launch latency across the scan: the reference hides per-batch launch
+        cost with its ThreadBuffer prefetch thread
+        (iter_batch_proc-inl.hpp:136-224); on TPU the idiomatic equivalent
+        is keeping the loop on device.  With ``with_outs`` the eval-node
+        outputs of every step are stacked and returned so the caller can
+        accumulate the train metric at full fidelity (one D2H per group
+        instead of per step).
         """
-        if nsteps in self._multi_step_cache:
-            return self._multi_step_cache[nsteps]
+        key = (nsteps, with_outs)
+        if key in self._multi_step_cache:
+            return self._multi_step_cache[key]
         assert self.update_period == 1, \
             "update_many requires update_period=1 (use update() for " \
             "gradient accumulation)"
+        eval_ids = tuple(dict.fromkeys(self.eval_node_ids)) if with_outs \
+            else ()
 
         def body(carry, xs):
             params, opt_state, buffers, epoch, rng_base = carry
@@ -367,16 +371,18 @@ class NetTrainer:
             # epoch here == sample_counter-1 of the equivalent update() call,
             # which folds AFTER incrementing — hence epoch + 1
             rng = jax.random.fold_in(rng_base, epoch + 1)
-            (loss, (new_buffers, _, _)), grads = self._loss_and_grads(
-                params, buffers, data, label_vec, (), epoch, rng, ())
+            (loss, (new_buffers, outs, _)), grads = self._loss_and_grads(
+                params, buffers, data, label_vec, (), epoch, rng, eval_ids)
             new_p, new_s = self._apply_update(params, opt_state, grads, epoch)
-            return (new_p, new_s, new_buffers, epoch + 1, rng_base), loss
+            return ((new_p, new_s, new_buffers, epoch + 1, rng_base),
+                    (loss, outs))
 
         def run(params, opt_state, buffers, epoch, rng_base, datas, labels):
             carry = (params, opt_state, buffers, epoch, rng_base)
-            carry, losses = jax.lax.scan(body, carry, (datas, labels))
+            carry, (losses, outs) = jax.lax.scan(
+                body, carry, (datas, labels))
             params, opt_state, buffers, epoch, _ = carry
-            return params, opt_state, buffers, losses
+            return params, opt_state, buffers, losses, outs
 
         stacked = NamedSharding(self.mesh, P(None, *self.batch_shard.spec))
         fn = jax.jit(
@@ -385,23 +391,33 @@ class NetTrainer:
                           self.buffer_shardings, self.repl, self.repl,
                           stacked, stacked),
             out_shardings=(self.param_shardings, self.opt_shardings,
-                           self.buffer_shardings, self.repl),
+                           self.buffer_shardings, self.repl, self.repl),
             donate_argnums=(0, 1, 2))
-        self._multi_step_cache[nsteps] = fn
+        self._multi_step_cache[key] = fn
         return fn
 
-    def update_many(self, datas, labels) -> "jnp.ndarray":
+    def _device_stacked(self, arr, dtype=None):
+        """(k, batch, ...) host stack -> device array; multi-host processes
+        hold their slice of dim 1 (the global batch)."""
+        return self._device_put(
+            arr, dtype,
+            NamedSharding(self.mesh, P(None, *self.batch_shard.spec)),
+            lambda a: (a.shape[0], self.batch_size) + a.shape[2:])
+
+    def update_many(self, datas, labels, with_outs: bool = False):
         """Run ``k`` sequential training steps in one device dispatch.
 
         ``datas``: (k, batch, c, h, w); ``labels``: (k, batch, label_width).
-        Returns the (k,) per-step losses (lazy device array).  Train metrics
-        and ``_last_outs`` are NOT accumulated (see _build_multi_step).
+        Returns the (k,) per-step losses (lazy device array); with
+        ``with_outs`` returns ``(losses, outs)`` where ``outs`` maps eval
+        node id -> (k, batch, width) stacked outputs for train-metric
+        accumulation.
         """
-        datas = jnp.asarray(datas)
-        labels = jnp.asarray(labels, jnp.float32)
+        datas = self._device_stacked(datas)
+        labels = self._device_stacked(labels, jnp.float32)
         k = datas.shape[0]
-        fn = self._build_multi_step(k)
-        (self.params, self.opt_state, self.buffers, losses) = fn(
+        fn = self._build_multi_step(k, with_outs)
+        (self.params, self.opt_state, self.buffers, losses, outs) = fn(
             self.params, self.opt_state, self.buffers,
             jnp.int32(self.epoch_counter), self._rng_base, datas, labels)
         self.sample_counter += k
@@ -409,6 +425,8 @@ class NetTrainer:
         self._last_loss = losses[-1]
         self._last_outs = None
         self._last_diags = None
+        if with_outs:
+            return losses, outs
         return losses
 
     def _get_eval_step(self, node_ids: Tuple[int, ...]):
@@ -435,7 +453,13 @@ class NetTrainer:
         self.train_metric.clear()
 
     def _device_batch(self, arr, dtype=None):
-        """Host batch -> device array under the batch sharding.
+        """Host batch -> device array under the batch sharding."""
+        return self._device_put(
+            arr, dtype, self.batch_shard,
+            lambda a: (self.batch_size,) + a.shape[1:])
+
+    def _device_put(self, arr, dtype, sharding, global_shape_fn):
+        """Host array -> device array under ``sharding``.
 
         Single-process: plain transfer (XLA shards it).  Multi-host: each
         process holds only its slice of the global batch (the data iterator
@@ -446,9 +470,8 @@ class NetTrainer:
             return arr.astype(dtype) if dtype and arr.dtype != dtype else arr
         arr = np.asarray(arr, dtype) if dtype else np.asarray(arr)
         if jax.process_count() > 1:
-            global_shape = (self.batch_size,) + arr.shape[1:]
             return jax.make_array_from_process_local_data(
-                self.batch_shard, arr, global_shape)
+                sharding, arr, global_shape_fn(arr))
         return jnp.asarray(arr)
 
     def _grad_acc_init(self):

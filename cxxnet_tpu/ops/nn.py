@@ -79,23 +79,11 @@ def conv2d_s2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int,
     layer math.
     """
     s = stride
-    n, c, h, w_in = x.shape
     co, ci, kh, kw = w.shape
-    assert ci == c, "conv2d_s2d: grouped conv not supported"
-    oh = conv_out_size(h, kh, s, pad_y)
-    ow = conv_out_size(w_in, kw, s, pad_x)
-    kb_y = -(-kh // s)  # ceil
-    kb_x = -(-kw // s)
-    hb, wb = oh - 1 + kb_y, ow - 1 + kb_x
-    # pad: requested conv padding, then up to whole blocks; a strided conv
-    # may also leave unconsumed tail rows/cols (floor in conv_out_size), so
-    # clamp the trailing pad at 0 and slice the block grid to size
-    xp = jnp.pad(x, ((0, 0), (0, 0),
-                     (pad_y, max(0, hb * s - h - pad_y)),
-                     (pad_x, max(0, wb * s - w_in - pad_x))))
-    xp = xp[:, :, :hb * s, :wb * s]
-    xb = xp.reshape(n, c, hb, s, wb, s)
-    xb = xb.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * s * s, hb, wb)
+    assert ci == x.shape[1], "conv2d_s2d: grouped conv not supported"
+    oh = conv_out_size(x.shape[2], kh, s, pad_y)
+    ow = conv_out_size(x.shape[3], kw, s, pad_x)
+    xb, kb_y, kb_x = s2d_input(x, s, kh, kw, oh, ow, pad_y, pad_x)
     wp = jnp.pad(w, ((0, 0), (0, 0),
                      (0, kb_y * s - kh), (0, kb_x * s - kw)))
     wb_ = wp.reshape(co, ci, kb_y, s, kb_x, s)
@@ -104,6 +92,91 @@ def conv2d_s2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int,
         xb, wb_.astype(xb.dtype), window_strides=(1, 1),
         padding=((0, 0), (0, 0)),
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def s2d_input(x: jnp.ndarray, stride: int, kh: int, kw: int,
+              oh: int, ow: int, pad_y: int, pad_x: int):
+    """The x-side space-to-depth rearrangement shared by conv2d_s2d and the
+    Pallas wgrad kernel: (n, c, h, w) -> (n, c*s*s, hb, wb) with channel
+    order (c, sy, sx), matching the weight-side layout above.  Returns
+    ``(xb, kb_y, kb_x)``."""
+    s = stride
+    n, c, h, w = x.shape
+    kb_y, kb_x = -(-kh // s), -(-kw // s)  # ceil
+    hb, wb = oh - 1 + kb_y, ow - 1 + kb_x
+    # pad: requested conv padding, then up to whole blocks; a strided conv
+    # may also leave unconsumed tail rows/cols (floor in conv_out_size), so
+    # clamp the trailing pad at 0 and slice the block grid to size
+    xp = jnp.pad(x, ((0, 0), (0, 0),
+                     (pad_y, max(0, hb * s - h - pad_y)),
+                     (pad_x, max(0, wb * s - w - pad_x))))
+    xp = xp[:, :, :hb * s, :wb * s]
+    xb = xp.reshape(n, c, hb, s, wb, s)
+    return (xb.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * s * s, hb, wb),
+            kb_y, kb_x)
+
+
+# Weight-grad strategy for the small-cin/large-stride conv geometry
+# (AlexNet conv1), where XLA's dilated-dy wgrad starves the MXU (~26%
+# efficiency, BASELINE.md): "s2d" (default) computes dW through the
+# space-to-depth identity (dense stride-1 inner wgrad, pure XLA);
+# "pallas" uses the in-VMEM im2col Pallas kernel (interpret-only for now —
+# its minor-dim reshapes are rejected by Mosaic on real TPU); "off" keeps
+# XLA's dilated formulation.
+_FAST_WGRAD = os.environ.get("CXXNET_FAST_WGRAD", "s2d")
+
+
+def use_fast_wgrad(cin: int, stride: int, num_group: int) -> bool:
+    """The geometry class where XLA's dilated wgrad starves the MXU."""
+    import jax
+    return (_FAST_WGRAD != "off" and num_group == 1 and stride >= 2
+            and cin <= 4 and jax.default_backend() == "tpu")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def conv_bias_fast(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
+                   stride: int, pad_y: int, pad_x: int) -> jnp.ndarray:
+    """conv2d + bias with a Pallas weight/bias-grad backward.
+
+    Forward is the ordinary XLA conv (already fast).  Backward computes
+    dW+db in one Pallas kernel (ops.pallas_kernels.conv_wgrad_s2d_pallas)
+    and dx through XLA's transposed conv — which XLA dead-code-eliminates
+    when the conv sits on the data layer, the AlexNet conv1 case.
+    """
+    out = conv2d(x, w, stride=stride, pad_y=pad_y, pad_x=pad_x)
+    return out + b.astype(out.dtype).reshape(1, -1, 1, 1)
+
+
+def _conv_bias_fast_fwd(x, w, b, stride, pad_y, pad_x):
+    return conv_bias_fast(x, w, b, stride, pad_y, pad_x), (x, w)
+
+
+def _conv_bias_fast_bwd(stride, pad_y, pad_x, res, dy):
+    x, w = res
+    co, ci, kh, kw = w.shape
+    if _FAST_WGRAD == "pallas":
+        from .pallas_kernels import conv_wgrad_s2d_pallas
+        # interpret=True: Mosaic rejects the kernel's minor-dim reshapes on
+        # real TPU (see conv_wgrad_s2d_pallas), so this mode is a
+        # correctness/debugging path, not a fast one
+        dw, db = conv_wgrad_s2d_pallas(x, dy, kh=kh, kw=kw, stride=stride,
+                                       pad_y=pad_y, pad_x=pad_x,
+                                       interpret=True)
+        dw = dw.astype(w.dtype)
+        db = db.astype(w.dtype)
+    else:  # "s2d": dense stride-1 inner wgrad via the s2d identity
+        _, vjp_w = jax.vjp(
+            lambda wv: conv2d_s2d(x, wv, stride=stride,
+                                  pad_y=pad_y, pad_x=pad_x), w)
+        (dw,) = vjp_w(dy)
+        db = jnp.sum(dy, axis=(0, 2, 3)).astype(w.dtype)
+    _, vjp_x = jax.vjp(
+        lambda xv: conv2d(xv, w, stride=stride, pad_y=pad_y, pad_x=pad_x), x)
+    (dx,) = vjp_x(dy)
+    return dx, dw, db
+
+
+conv_bias_fast.defvjp(_conv_bias_fast_fwd, _conv_bias_fast_bwd)
 
 
 def pool_out_size_padded(in_size: int, ksize: int, stride: int,
@@ -161,14 +234,56 @@ def _max_pool_eq_fwd(x, ksize_y, ksize_x, stride, pad_y, pad_x):
     return y, (x, y)
 
 
+def _cand_indices(in_size: int, k: int, s: int, pad: int, out_size: int):
+    """For each input position a, the candidate window indices covering it:
+    w in [ceil((a+pad-k+1)/s), floor((a+pad)/s)] ∩ [0, out_size).  Returns
+    (ncand, in_size) index + validity arrays, ncand = ceil(k/s) or fewer."""
+    a = np.arange(in_size) + pad
+    lo = -(-(a - k + 1) // s)
+    hi = np.minimum(a // s, out_size - 1)
+    ncand = int(np.max(hi - lo + 1)) if in_size else 0
+    idx = np.stack([lo + t for t in range(ncand)])
+    valid = (idx >= 0) & (idx <= hi)
+    return np.clip(idx, 0, out_size - 1), valid
+
+
+def _max_pool_eq_bwd_gather(ksize_y, ksize_x, stride, pad_y, pad_x, res, dy):
+    """Candidate-gather unpool: same all-ties semantics as _max_pool_eq_bwd,
+    but formulated as <= ceil(k/s)^2 static row/column gathers of (y, dy)
+    back to the input grid instead of kx*ky dilated pads — each input
+    position is covered by at most ceil(k/s)^2 windows, so this reads far
+    less than the per-offset formulation when stride < kernel."""
+    x, y = res
+    n, c, h, w = x.shape
+    oh, ow = y.shape[2], y.shape[3]
+    iy, vy = _cand_indices(h, ksize_y, stride, pad_y, oh)
+    ix, vx = _cand_indices(w, ksize_x, stride, pad_x, ow)
+    dx = None
+    zero = jnp.zeros((), dy.dtype)
+    for t in range(iy.shape[0]):
+        y_r = jnp.take(y, jnp.asarray(iy[t]), axis=2)
+        dy_r = jnp.take(dy, jnp.asarray(iy[t]), axis=2)
+        my = jnp.asarray(vy[t])[None, None, :, None]
+        for u in range(ix.shape[0]):
+            y_c = jnp.take(y_r, jnp.asarray(ix[u]), axis=3)
+            dy_c = jnp.take(dy_r, jnp.asarray(ix[u]), axis=3)
+            m = my & jnp.asarray(vx[u])[None, None, None, :]
+            contrib = jnp.where(m & (x == y_c), dy_c, zero)
+            dx = contrib if dx is None else dx + contrib
+    return (dx,)
+
+
 def _max_pool_eq_bwd(ksize_y, ksize_x, stride, pad_y, pad_x, res, dy):
     """Equality-mask max-pool backward (mshadow ``unpool<red::maximum>``
     semantics: every input equal to its window's max receives the window's
     gradient — ties propagate to ALL maxima, unlike XLA select-and-scatter
-    which picks one).  Measured ~1.8x SLOWER than select-and-scatter in a
-    full AlexNet step on v5e (see _POOL_BWD above) — the kx*ky
-    dilate-and-add passes materialize instead of fusing — so this is the
-    exact-semantics opt-in, not the fast path."""
+    which picks one).  Two formulations, picked by CXXNET_POOL_BWD:
+    "eq" = kx*ky dilate-and-add passes (measured ~1.8x slower than SAS in
+    a full AlexNet step on v5e: the pads materialize); "gather" =
+    candidate-window gathers (_max_pool_eq_bwd_gather)."""
+    if _POOL_BWD == "gather":
+        return _max_pool_eq_bwd_gather(ksize_y, ksize_x, stride,
+                                       pad_y, pad_x, res, dy)
     x, y = res
     n, c, h, w = x.shape
     oh, ow = y.shape[2], y.shape[3]
@@ -201,7 +316,7 @@ _max_pool_eq.defvjp(_max_pool_eq_fwd, _max_pool_eq_bwd)
 
 def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
                pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
-    if _POOL_BWD == "eq":
+    if _POOL_BWD in ("eq", "gather"):
         return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     return _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
 
@@ -224,6 +339,44 @@ def avg_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
     (pooling_layer-inl.hpp:47-53)."""
     s = sum_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     return s * jnp.array(1.0 / (ksize_y * ksize_x), x.dtype)
+
+
+def jitter5(x: jnp.ndarray, mask: jnp.ndarray, p_keep: float) -> jnp.ndarray:
+    """Stochastic neighbor redirect (insanity_pooling_layer-inl.hpp:70-93).
+
+    Per position, ``mask`` (uniform [0,1), same shape as x) picks one of five
+    sources with band boundaries p, p+d, p+2d, p+3d (d = (1-p)/4): the
+    position itself, or its y-1 / y+1 / x-1 / x+1 neighbor, edge-clamped.
+    Returns the jittered image xj with xj[y,x] = x[loc_y, loc_x].
+    """
+    d = (1.0 - p_keep) / 4.0
+    up = jnp.concatenate([x[:, :, :1], x[:, :, :-1]], axis=2)      # x[y-1]
+    down = jnp.concatenate([x[:, :, 1:], x[:, :, -1:]], axis=2)    # x[y+1]
+    left = jnp.concatenate([x[:, :, :, :1], x[:, :, :, :-1]], axis=3)
+    right = jnp.concatenate([x[:, :, :, 1:], x[:, :, :, -1:]], axis=3)
+    return jnp.where(mask < p_keep, x,
+           jnp.where(mask < p_keep + d, up,
+           jnp.where(mask < p_keep + 2 * d, down,
+           jnp.where(mask < p_keep + 3 * d, left, right))))
+
+
+def insanity_max_pool(x: jnp.ndarray, mask: jnp.ndarray, ksize_y: int,
+                      ksize_x: int, stride: int, p_keep: float) -> jnp.ndarray:
+    """Train-time insanity pooling, exact reference semantics
+    (insanity_pooling_layer-inl.hpp:13-49 forward, :150-210 backward).
+
+    Forward: max over the window of the JITTERED image (each (y,x) read is
+    redirected by the mask — the same redirect for every window covering it).
+    Backward: the reference's insanity_unpool propagates the pooled gradient
+    to the *window position* (y,x) whenever its jittered value equals the
+    window max (``Reducer::PartialGrad`` — ALL ties receive gradient), NOT
+    through the jitter gather; the straight-through term below reproduces
+    exactly that: value is xj, gradient w.r.t. x is the eq-mask unpool of xj
+    assigned at-position.
+    """
+    xj = jitter5(x, mask, p_keep)
+    xj = x + lax.stop_gradient(xj - x)
+    return _max_pool_eq(xj, ksize_y, ksize_x, stride, 0, 0)
 
 
 def chpool_sum(x: jnp.ndarray, nsize: int) -> jnp.ndarray:

@@ -47,31 +47,36 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _block_spec(c: int, hw: int):
-    """One batch row (1, C, HW) per grid step, resident in VMEM."""
+def _block_spec(nb: int, c: int, hw: int):
+    """(NB, C, HW) batch-tile per grid step, resident in VMEM.  NB > 1
+    matters: one-row blocks ran 1024 programs per call on AlexNet shapes and
+    the per-program overhead swamped the kernel."""
     if _VMEM is None:
-        return pl.BlockSpec((1, c, hw), lambda i: (i, 0, 0))
-    return pl.BlockSpec((1, c, hw), lambda i: (i, 0, 0), memory_space=_VMEM)
+        return pl.BlockSpec((nb, c, hw), lambda i: (i, 0, 0))
+    return pl.BlockSpec((nb, c, hw), lambda i: (i, 0, 0), memory_space=_VMEM)
 
 
 def _chwin_sum(sq: jnp.ndarray, nsize: int,
                transpose: bool = False) -> jnp.ndarray:
-    """Windowed sum over axis 0 (channels) of a (C, HW) block: element j sums
-    sq[j-lo .. j+hi] with lo = nsize//2, hi = nsize-1-lo — ``chpool_sum``'s
-    window placement.  ``transpose=True`` swaps lo/hi, giving the adjoint
-    window needed by the backward pass for even nsize."""
-    c = sq.shape[0]
+    """Windowed sum over axis 1 (channels) of an (NB, C, HW) block: element
+    j sums sq[j-lo .. j+hi] with lo = nsize//2, hi = nsize-1-lo —
+    ``chpool_sum``'s window placement.  ``transpose=True`` swaps lo/hi,
+    giving the adjoint window needed by the backward pass for even nsize."""
+    c = sq.shape[1]
     lo = nsize // 2
     hi = nsize - 1 - lo
     if transpose:
         lo, hi = hi, lo
+    zshape = list(sq.shape)
     acc = sq
     for off in range(1, hi + 1):  # channels above j
+        zshape[1] = off
         acc = acc + jnp.concatenate(
-            [sq[off:], jnp.zeros((off,) + sq.shape[1:], sq.dtype)], axis=0)
+            [sq[:, off:], jnp.zeros(zshape, sq.dtype)], axis=1)
     for off in range(1, lo + 1):  # channels below j
+        zshape[1] = off
         acc = acc + jnp.concatenate(
-            [jnp.zeros((off,) + sq.shape[1:], sq.dtype), sq[:c - off]], axis=0)
+            [jnp.zeros(zshape, sq.dtype), sq[:, :c - off]], axis=1)
     return acc
 
 
@@ -83,33 +88,44 @@ def _norm_pow(norm: jnp.ndarray, beta: float) -> jnp.ndarray:
 
 
 def _lrn_fwd_kernel(x_ref, o_ref, *, nsize, salpha, beta, knorm):
-    x = x_ref[0].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
     norm = _chwin_sum(x * x, nsize) * salpha + knorm
-    o_ref[0] = (x * _norm_pow(norm, beta)).astype(o_ref.dtype)
+    o_ref[...] = (x * _norm_pow(norm, beta)).astype(o_ref.dtype)
 
 
 def _lrn_bwd_kernel(x_ref, g_ref, dx_ref, *, nsize, salpha, beta, knorm):
-    x = x_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
     norm = _chwin_sum(x * x, nsize) * salpha + knorm
     npow = _norm_pow(norm, beta)              # norm^-b
     inner = g * x * (npow / norm)             # g x norm^{-b-1}
     dx = g * npow - (2.0 * beta * salpha) * x * _chwin_sum(
         inner, nsize, transpose=True)
-    dx_ref[0] = dx.astype(dx_ref.dtype)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _lrn_batch_tile(n: int, c: int, hw: int, itemsize: int) -> int:
+    """Largest batch tile dividing n with a ~1MB input block: the backward
+    kernel holds ~6 f32 block-sized temporaries plus the in/out blocks, so
+    a bigger block blows the 16MB scoped-vmem limit."""
+    nb = max(1, (1 << 20) // max(c * hw * itemsize, 1))
+    while n % nb != 0:
+        nb -= 1
+    return nb
 
 
 def _call_per_batch(kernel, out_dtype, nsize, salpha, beta, knorm, *args3d,
                     interpret):
     n, c, hw = args3d[0].shape
+    nb = _lrn_batch_tile(n, c, hw, args3d[0].dtype.itemsize)
     kern = functools.partial(kernel, nsize=nsize, salpha=salpha, beta=beta,
                              knorm=knorm)
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((n, c, hw), out_dtype),
-        grid=(n,),
-        in_specs=[_block_spec(c, hw) for _ in args3d],
-        out_specs=_block_spec(c, hw),
+        grid=(n // nb,),
+        in_specs=[_block_spec(nb, c, hw) for _ in args3d],
+        out_specs=_block_spec(nb, c, hw),
         interpret=interpret,
     )(*args3d)
 
@@ -155,6 +171,92 @@ lrn_pallas.defvjp(_lrn_fwd_res, _lrn_bwd_res)
 # entirely.  Matmul operands stay bf16 (MXU fast path) with f32
 # accumulation; block sizes 512x1024 amortise per-program overhead (the
 # first cut at 128x128 ran 131k programs and was slower than XLA).
+
+# --------------------------------------------------------------------------
+# Strided-conv weight gradient.  XLA computes the wgrad of a strided conv by
+# dilating dy with (stride-1) zeros, so for AlexNet conv1 (11x11 / stride 4 /
+# cin 3) ~15/16 of the MXU contraction is zeros (~26% efficiency, BASELINE.md
+# profile).  This kernel removes the dilation with the space-to-depth
+# identity: the stride-s conv equals a stride-1 conv over s2d-rearranged
+# input (ops.nn.conv2d_s2d), whose wgrad is a DENSE contraction
+#
+#     dW_inner[o, (c*s*s)*(kb*kb)] = sum_{n,oh,ow} dy[n,o,oh,ow] *
+#                                    x_s2d[n, c*s*s, oh+dh, ow+dw]
+#
+# evaluated as one (96 x K) @ (K x 432)-shaped MXU matmul per image, with
+# the im2col block built tile-wise in VMEM (never materialised to HBM).
+# The (co, ci*s*s, kb, kb) result maps back to OIHW outside the kernel.
+
+
+def _conv_wgrad_kernel(x_ref, dy_ref, o_ref, ob_ref, acc, accb, *, nb, co,
+                       cin_b, oh, ow, kb_y, kb_x):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        accb[...] = jnp.zeros_like(accb)
+
+    for i in range(nb):
+        dy2 = dy_ref[i].reshape(co, oh * ow)
+        cols = jnp.concatenate(
+            [x_ref[i, :, dh:dh + oh, dw:dw + ow].reshape(cin_b, oh * ow)
+             for dh in range(kb_y) for dw in range(kb_x)], axis=0)
+        acc[...] += jax.lax.dot_general(
+            dy2, cols, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # bias grad rides along: dy is already in VMEM, so the row-sum is
+        # free compared to the separate full-activation reduce XLA emits
+        accb[...] += jnp.sum(dy2.astype(jnp.float32), axis=1)[None, :]
+
+    @pl.when(pl.program_id(0) == pl.num_programs(0) - 1)
+    def _():
+        o_ref[...] = acc[...]
+        ob_ref[...] = accb[...]
+
+
+def conv_wgrad_s2d_pallas(x: jnp.ndarray, dy: jnp.ndarray, *, kh: int,
+                          kw: int, stride: int, pad_y: int = 0,
+                          pad_x: int = 0, nb: int = 8,
+                          interpret: bool = None):
+    """Weight + bias gradient of a stride-s 2D conv (no groups), NCHW/OIHW.
+
+    Returns ``(dW (co, ci, kh, kw), db (co,))`` in float32.  Intended for
+    the small-input-channel / large-stride geometry class (AlexNet conv1)
+    where XLA's dilated-dy formulation starves the MXU; see module comment.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    from .nn import s2d_input
+    n, c, h, w = x.shape
+    _, co, oh, ow = dy.shape
+    s = stride
+    xs2d, kb_y, kb_x = s2d_input(x, s, kh, kw, oh, ow, pad_y, pad_x)
+    cin_b = c * s * s
+    while n % nb != 0:
+        nb //= 2
+    kern = functools.partial(_conv_wgrad_kernel, nb=nb, co=co, cin_b=cin_b,
+                             oh=oh, ow=ow, kb_y=kb_y, kb_x=kb_x)
+    ncols = cin_b * kb_y * kb_x
+    hb, wb = oh - 1 + kb_y, ow - 1 + kb_x
+    dw_inner, db = pl.pallas_call(
+        kern,
+        grid=(n // nb,),
+        in_specs=[pl.BlockSpec((nb, cin_b, hb, wb), lambda i: (i, 0, 0, 0)),
+                  pl.BlockSpec((nb, co, oh, ow), lambda i: (i, 0, 0, 0))],
+        out_specs=[pl.BlockSpec((co, ncols), lambda i: (0, 0)),
+                   pl.BlockSpec((1, co), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((co, ncols), jnp.float32),
+                   jax.ShapeDtypeStruct((1, co), jnp.float32)],
+        scratch_shapes=_scratch((co, ncols), (1, co)),
+        interpret=interpret,
+    )(xs2d, dy)
+    # invert conv2d_s2d's weight layout: columns are ordered
+    # (seg=(dh,dw)) x (c, sy, sx); padded taps (dh*s+sy >= kh) are zero in
+    # the contraction and sliced away here
+    dw6 = dw_inner.reshape(co, kb_y, kb_x, c, s, s)
+    dw6 = dw6.transpose(0, 3, 1, 4, 2, 5)  # (co, c, kb_y, sy, kb_x, sx)
+    dwp = dw6.reshape(co, c, kb_y * s, kb_x * s)
+    return dwp[:, :, :kh, :kw], db[0]
+
 
 NEG_INF = -1e30
 

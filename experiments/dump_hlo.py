@@ -1,0 +1,31 @@
+"""Dump the optimized HLO of the AlexNet multi-step train program."""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    batch, scan_len = 1024, 2
+    from __graft_entry__ import ALEXNET_NET, _make_trainer
+    t = _make_trainer(ALEXNET_NET, batch, "tpu",
+                      extra=[("dtype", "bfloat16"), ("eval_train", "0")])
+    fn = t._build_multi_step(scan_len)
+    rnd = np.random.RandomState(0)
+    datas = jnp.zeros((scan_len, batch, 3, 227, 227), jnp.bfloat16)
+    labels = jnp.zeros((scan_len, batch, 1), jnp.float32)
+    lowered = fn.lower(t.params, t.opt_state, t.buffers,
+                       jnp.int32(0), t._rng_base, datas, labels)
+    compiled = lowered.compile()
+    out = "/tmp/alexnet_step.hlo"
+    with open(out, "w") as f:
+        f.write(compiled.as_text())
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

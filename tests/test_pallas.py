@@ -53,6 +53,160 @@ def test_lrn_dispatch_forced_pallas(monkeypatch):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("shape,conv",
+                         [((4, 3, 23, 23, 8, 11, 4, 0), "alexnet-conv1"),
+                          ((2, 3, 16, 16, 16, 5, 2, 2), "padded"),
+                          ((8, 4, 15, 15, 8, 7, 3, 1), "odd")])
+def test_conv_wgrad_pallas_matches_vjp(shape, conv):
+    """Space-to-depth Pallas weight/bias-grad == XLA's conv VJP."""
+    from cxxnet_tpu.ops.pallas_kernels import conv_wgrad_s2d_pallas
+    n, c, h, w, co, k, s, p = shape
+    rnd = np.random.RandomState(0)
+    x = jnp.asarray(rnd.rand(n, c, h, w).astype(np.float32))
+    wt = jnp.asarray((rnd.rand(co, c, k, k) - 0.5).astype(np.float32))
+    y = N.conv2d(x, wt, stride=s, pad_y=p, pad_x=p)
+    dy = jnp.asarray(rnd.rand(*y.shape).astype(np.float32))
+    dw_ref = jax.vjp(
+        lambda wv: N.conv2d(x, wv, stride=s, pad_y=p, pad_x=p), wt)[1](dy)[0]
+    dw, db = conv_wgrad_s2d_pallas(x, dy, kh=k, kw=k, stride=s,
+                                   pad_y=p, pad_x=p)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(db),
+                               np.asarray(dy.sum(axis=(0, 2, 3))),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_bias_fast_full_vjp():
+    """conv_bias_fast == conv2d+bias in value and all three gradients."""
+    rnd = np.random.RandomState(1)
+    n, c, h, w, co, k, s = 2, 3, 23, 23, 8, 11, 4
+    x = jnp.asarray(rnd.rand(n, c, h, w).astype(np.float32))
+    wt = jnp.asarray((rnd.rand(co, c, k, k) - 0.5).astype(np.float32))
+    b = jnp.asarray(rnd.rand(co).astype(np.float32))
+
+    def ref(wt, b, xv):
+        return N.conv2d(xv, wt, stride=s) + b.reshape(1, -1, 1, 1)
+
+    def fast(wt, b, xv):
+        return N.conv_bias_fast(xv, wt, b, s, 0, 0)
+
+    y_ref, y_fast = ref(wt, b, x), fast(wt, b, x)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+    dy = jnp.asarray(rnd.rand(*y_ref.shape).astype(np.float32))
+    gr = jax.vjp(ref, wt, b, x)[1](dy)
+    gf = jax.vjp(fast, wt, b, x)[1](dy)
+    for a, bb, name in zip(gr, gf, ("dw", "db", "dx")):
+        np.testing.assert_allclose(np.asarray(bb), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def _insanity_oracle(x, mask, k, s, p_keep):
+    """Direct transcription of the reference's InsanityPoolingExp /
+    InsanityUnPoolingExp Eval loops (insanity_pooling_layer-inl.hpp:70-93,
+    :178-210) for a single (n, c) plane stack."""
+    n, c, h, w = x.shape
+    d = (1.0 - p_keep) / 4.0
+    # jittered read location per input position
+    loc = np.empty((n, c, h, w, 2), np.int64)
+    for ni in range(n):
+        for ci in range(c):
+            for y in range(h):
+                for xx in range(w):
+                    ly, lx = y, xx
+                    f = mask[ni, ci, y, xx]
+                    if f < p_keep:
+                        pass
+                    elif f < p_keep + d:
+                        ly = ly - 1 if ly > 0 else ly
+                    elif f < p_keep + 2 * d:
+                        ly = ly + 1 if ly + 1 < h else h - 1
+                    elif f < p_keep + 3 * d:
+                        lx = lx - 1 if lx > 0 else lx
+                    else:
+                        lx = lx + 1 if lx + 1 < w else w - 1
+                    loc[ni, ci, y, xx] = (ly, lx)
+    oh = min(h - k + s - 1, h - 1) // s + 1
+    ow = min(w - k + s - 1, w - 1) // s + 1
+    out = np.full((n, c, oh, ow), -np.inf, np.float32)
+    for ni in range(n):
+        for ci in range(c):
+            for py in range(oh):
+                for px in range(ow):
+                    for y in range(py * s, min(py * s + k, h)):
+                        for xx in range(px * s, min(px * s + k, w)):
+                            ly, lx = loc[ni, ci, y, xx]
+                            out[ni, ci, py, px] = max(
+                                out[ni, ci, py, px], x[ni, ci, ly, lx])
+    # backward: grad to window positions whose jittered value ties the max
+    def bwd(dy):
+        dx = np.zeros_like(x)
+        for ni in range(n):
+            for ci in range(c):
+                for y in range(h):
+                    for xx in range(w):
+                        ly, lx = loc[ni, ci, y, xx]
+                        vsrc = x[ni, ci, ly, lx]
+                        py_min = 0 if y < k else (y - k + s) // s
+                        px_min = 0 if xx < k else (xx - k + s) // s
+                        py_max = min((y + s) // s, oh)
+                        px_max = min((xx + s) // s, ow)
+                        val = 0.0
+                        for py in range(py_min, py_max):
+                            for px in range(px_min, px_max):
+                                if vsrc == out[ni, ci, py, px]:
+                                    val += dy[ni, ci, py, px]
+                        dx[ni, ci, y, xx] = val
+        return dx
+    return out, bwd
+
+
+def test_insanity_pool_exact_semantics():
+    """insanity_max_pool == the reference expression's Eval loops, forward
+    and backward (numpy oracle transcription)."""
+    rnd = np.random.RandomState(0)
+    for (h, w, k, s, keep) in [(7, 7, 3, 2, 0.6), (6, 8, 2, 2, 0.0),
+                               (9, 9, 3, 3, 0.9)]:
+        x = rnd.randint(0, 6, (2, 3, h, w)).astype(np.float32)
+        mask = rnd.rand(2, 3, h, w).astype(np.float32)
+        want, oracle_bwd = _insanity_oracle(x, mask, k, s, keep)
+        got, vjp = jax.vjp(
+            lambda v: N.insanity_max_pool(jnp.asarray(v), jnp.asarray(mask),
+                                          k, k, s, keep), x)
+        np.testing.assert_allclose(np.asarray(got), want, err_msg=(h, k, s))
+        dy = rnd.rand(*want.shape).astype(np.float32)
+        (dx,) = vjp(jnp.asarray(dy))
+        np.testing.assert_allclose(np.asarray(dx), oracle_bwd(dy),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=(h, k, s, keep))
+
+
+def test_insanity_pool_layer_eval_is_max_pool():
+    from cxxnet_tpu.layers.registry import create_layer
+    from cxxnet_tpu.layers.base import ForwardContext
+    layer = create_layer("insanity_max_pooling")
+    layer.set_param("kernel_size", "3")
+    layer.set_param("stride", "2")
+    layer.set_param("keep", "0.7")
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 9, 9), jnp.float32)
+    (out,), _ = layer.forward({}, {}, [x], ForwardContext(train=False))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(N.max_pool2d(x, 3, 3, 2)))
+
+
+def test_relu_vjp_masks_from_output():
+    """Relu's custom VJP (mask from the output, reference op.h relu_grad)
+    matches jax.nn.relu's gradient everywhere except the measure-zero x=0."""
+    from cxxnet_tpu.layers.activation import _relu_out_grad
+    x = jnp.asarray([[-2.0, -0.5, 0.0, 0.5, 2.0]])
+    np.testing.assert_array_equal(np.asarray(_relu_out_grad(x)),
+                                  np.asarray(jax.nn.relu(x)))
+    g = jax.grad(lambda v: _relu_out_grad(v).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g),
+                                  np.asarray([[0.0, 0.0, 0.0, 1.0, 1.0]]))
+
+
 def test_flash_attention_matches_dense():
     """Pallas flash attention (interpret mode on CPU) == dense attention,
     forward and backward, causal and not, bf16 and f32."""

@@ -85,6 +85,34 @@ def test_train_metric_reporting():
     assert "train-error:" in line
 
 
+def test_update_many_matches_update_sequence():
+    """The multi-step scan path (with stacked eval outputs) follows the
+    exact same parameter trajectory and train metric as k update() calls."""
+    ta = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    tb = make_trainer(MLP_CONF, extra=[("silent", "1")])
+    batches = synth_batches(6)
+    ta.start_round(1)
+    tb.start_round(1)
+    for b in batches:
+        ta.update(b)
+    datas = np.stack([b.data for b in batches])
+    labels = np.stack([b.label for b in batches])
+    _, outs = tb.update_many(datas, labels, with_outs=True)
+    for pkey, group in ta.params.items():
+        for tag, p in group.items():
+            np.testing.assert_allclose(
+                np.asarray(p), np.asarray(tb.params[pkey][tag]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{pkey}/{tag}")
+    # train metric from the stacked outputs equals the per-step one
+    outs_np = {nid: np.asarray(v) for nid, v in outs.items()}
+    for j, b in enumerate(batches):
+        preds = [outs_np[nid][j] for nid in tb.eval_node_ids]
+        tb.train_metric.add_eval(
+            preds, {name: b.label[:, a:bb]
+                    for name, a, bb in tb._label_fields})
+    assert ta.train_eval_line() == tb.train_eval_line()
+
+
 def test_evaluate_excludes_padding():
     t = make_trainer(MLP_CONF, extra=[("silent", "1")])
     b = synth_batches(1)[0]
