@@ -40,6 +40,10 @@ class LearnTask:
         self.silent = 0
         self.test_io = 0
         self.multi_step = 0
+        # diagnostic twin of test_io: test_io=1 isolates the input
+        # pipeline (no device work); synth_device_data=1 isolates the
+        # device loop (pre-staged on-device batches, no host transfer)
+        self.synth_device_data = 0
         self.extract_node_name = ""
         self.prof_dir = ""
         self.test_on_server = 0
@@ -85,6 +89,8 @@ class LearnTask:
             self.test_io = int(val)
         elif name == "multi_step":
             self.multi_step = int(val)
+        elif name == "synth_device_data":
+            self.synth_device_data = int(val)
         elif name == "extract_node_name":
             self.extract_node_name = val
         elif name == "eval_train":
@@ -179,6 +185,8 @@ class LearnTask:
 
     def _create_iterators(self) -> None:
         """Section scanner (reference CreateIterators, cxxnet_main.cpp:214-264)."""
+        if self.synth_device_data:
+            return  # device-loop diagnostic: no input pipeline
         flag = 0
         evname = ""
         itcfg: List[Tuple[str, str]] = []
@@ -228,6 +236,9 @@ class LearnTask:
         start = time.time()
         if self.continue_training == 0 and self.name_model_in == "NULL":
             self._save_model()
+        if self.synth_device_data:
+            self._train_synth_device()
+            return
         if self.itr_train is None:
             raise RuntimeError(
                 "task=train but the config has no 'data = train' iterator "
@@ -261,9 +272,13 @@ class LearnTask:
             # reference's ThreadBuffer keeping the GPU queue full
             # (iter_batch_proc-inl.hpp:136-224); train metrics stay exact
             # (outputs come back stacked, one D2H per group)
+            # pairtest nets stay on the per-batch path: grouped dispatch
+            # would drop their step diagnostics (reference exceedance
+            # reporting)
             group_n = self.multi_step if (
                 self.multi_step > 1 and self.test_io == 0
-                and self.net.update_period == 1) else 1
+                and self.net.update_period == 1
+                and not self.net.has_diagnostics) else 1
             pending = []
             done = False
             while not done:
@@ -325,6 +340,38 @@ class LearnTask:
         if not self.silent:
             print(f"\nupdating end, {int(time.time() - start)} sec in all")
 
+    def _train_synth_device(self) -> None:
+        """synth_device_data=1: run the REAL config-driven train loop on
+        pre-staged device-resident synthetic batches — the device-side twin
+        of ``test_io=1``.  Isolates the train-loop dispatch overhead from
+        host->device link bandwidth (over a tunneled dev TPU the link would
+        dominate any host-fed measurement); compare its examples/sec to
+        bench.py's pre-staged number to see the CLI loop's own cost."""
+        import jax.numpy as jnp
+        net = self.net
+        k = max(self.multi_step, 1)
+        shape = net.net.node_shapes[0]
+        nclass = net.net.node_shapes[net.net.final_node][-1]
+        rnd = np.random.RandomState(0)
+        datas = jnp.asarray(
+            rnd.rand(k, *shape).astype(np.float32)).astype(net.dtype)
+        labels = jnp.asarray(
+            rnd.randint(0, nclass, (k, shape[0], 1)).astype(np.float32))
+        start = time.time()
+        while self.start_counter <= self.num_round:
+            self.net.start_round(self.start_counter)
+            t0 = time.time()
+            losses = net.update_many(datas, labels)
+            np.asarray(losses)
+            dt = time.time() - t0
+            if not self.silent:
+                print(f"round {self.start_counter - 1:8d}: synth-device "
+                      f"{k} steps, {shape[0] * k / dt:.1f} examples/sec",
+                      flush=True)
+            self._save_model()
+        if not self.silent:
+            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+
     def _update_group(self, group) -> None:
         """Dispatch a group of batches as one on-device multi-step scan,
         accumulating the train metric from the stacked eval outputs."""
@@ -336,10 +383,8 @@ class LearnTask:
             _, outs = net.update_many(datas, labels, with_outs=True)
             outs = {nid: np.asarray(v) for nid, v in outs.items()}
             for j, b in enumerate(group):
-                preds = [outs[nid][j] for nid in net.eval_node_ids]
-                lab = {name: b.label[:, a:bb]
-                       for name, a, bb in net._label_fields}
-                net.train_metric.add_eval(preds, lab)
+                net.accumulate_train_metric(
+                    {nid: outs[nid][j] for nid in outs}, b.label)
         else:
             net.update_many(datas, labels)
 

@@ -504,10 +504,22 @@ class NetTrainer:
         self._last_outs = outs
         self._last_diags = diags
         if self.eval_train and self.train_metric.evals:
-            preds = [np.asarray(outs[nid]) for nid in self.eval_node_ids]
-            labels = {name: batch.label[:, a:b]
-                      for name, a, b in self._label_fields}
-            self.train_metric.add_eval(preds, labels)
+            self.accumulate_train_metric(outs, batch.label)
+
+    def accumulate_train_metric(self, outs, label) -> None:
+        """Add one batch's eval-node outputs to the train metric (shared by
+        the per-batch and grouped multi-step paths)."""
+        preds = [np.asarray(outs[nid]) for nid in self.eval_node_ids]
+        labels = {name: label[:, a:b] for name, a, b in self._label_fields}
+        self.train_metric.add_eval(preds, labels)
+
+    @property
+    def has_diagnostics(self) -> bool:
+        """True when any layer emits step diagnostics (pairtest); such nets
+        need the per-batch update path so _last_diags stays populated."""
+        from ..layers.pairtest import PairTestLayer
+        return any(isinstance(c.layer, PairTestLayer)
+                   for c in self.net.connections)
 
     def evaluate(self, data_iter, name: str) -> str:
         self.metric.clear()

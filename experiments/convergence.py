@@ -1,0 +1,143 @@
+"""Record convergence artifacts for the parity configs (BASELINE.md).
+
+Usage:
+  python experiments/convergence.py mnist      # MLP + LeNet, CPU, synthetic
+  python experiments/convergence.py imagenet   # AlexNet loss curve, TPU
+  python experiments/convergence.py googlenet  # GoogLeNet loss curve, TPU
+  python experiments/convergence.py dist       # 2-process DP, CPU
+
+Each subcommand appends one JSON line to CONVERGENCE.jsonl at the repo
+root: {"config", "setting", "metric", "values", "date"}.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "CONVERGENCE.jsonl")
+
+
+def record(config, setting, metric, values):
+    line = {"config": config, "setting": setting, "metric": metric,
+            "values": values,
+            "date": time.strftime("%Y-%m-%d")}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(line) + "\n")
+    print("recorded:", json.dumps(line))
+
+
+def _parse_metric_lines(stderr_text, name):
+    """[round]\t...name:value  ->  {round: value}"""
+    out = {}
+    for line in stderr_text.splitlines():
+        m = re.match(r"^\[(\d+)\]", line)
+        if not m:
+            continue
+        v = re.search(re.escape(name) + r":([0-9.eE+-]+)", line)
+        if v:
+            out[int(m.group(1))] = float(v.group(1))
+    return out
+
+
+def run_mnist():
+    """MNIST MLP + LeNet on the synthetic generator (no network egress in
+    this environment; reference reports ~98% on real MNIST,
+    example/MNIST/README.md:108)."""
+    work = tempfile.mkdtemp()
+    subprocess.run([sys.executable,
+                    os.path.join(ROOT, "tools", "make_synth_mnist.py"),
+                    "--out", os.path.join(work, "data"),
+                    "--train", "6000", "--test", "1000"],
+                   check=True, cwd=work)
+    for conf, tag in (("MNIST.conf", "mnist-mlp"),
+                      ("LeNet.conf", "mnist-lenet")):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT + ":" + env.get("PYTHONPATH", "")
+        p = subprocess.run(
+            [sys.executable, "-m", "cxxnet_tpu",
+             os.path.join(ROOT, "example", "MNIST", conf),
+             "num_round=6", "max_round=6", "dev=cpu",
+             f"model_dir={work}/m_{tag}", "save_model=0"],
+            cwd=work, env=env, capture_output=True, text=True, timeout=3600)
+        assert p.returncode == 0, p.stderr[-2000:]
+        errs = _parse_metric_lines(p.stderr, "test-error")
+        record(tag, "synthetic MNIST 6k/1k, 6 rounds, CPU",
+               "test-error by round", errs)
+
+
+def _loss_curve(net_conf, batch, steps, nclass, shape, extra=()):
+    import jax.numpy as jnp
+    from __graft_entry__ import _make_trainer
+    t = _make_trainer(net_conf, batch, "tpu",
+                      extra=[("dtype", "bfloat16"), ("eval_train", "0"),
+                             ("silent", "1"), *extra])
+    rnd = np.random.RandomState(0)
+    # learnable synthetic data: per-class low-res spatial prototype
+    # (8x8 per channel, nearest-upsampled) + noise
+    k = 5  # scan length per dispatch
+    protos = rnd.rand(nclass, shape[0], 8, 8).astype(np.float32)
+    ry, rx = -(-shape[1] // 8), -(-shape[2] // 8)
+    curves = []
+    for it in range(steps // k):
+        labels = rnd.randint(0, nclass, (k, batch))
+        pat = protos[labels]  # (k, batch, c, 8, 8)
+        pat = pat.repeat(ry, axis=3).repeat(rx, axis=4)[
+            :, :, :, :shape[1], :shape[2]]
+        data = pat + rnd.rand(k, batch, *shape).astype(np.float32) * 0.25
+        losses = t.update_many(jnp.asarray(data, jnp.bfloat16),
+                               jnp.asarray(labels[..., None], jnp.float32))
+        losses = np.asarray(losses)
+        curves.extend(float(x) for x in losses)
+    return curves
+
+
+def run_imagenet():
+    from __graft_entry__ import ALEXNET_NET
+    curve = _loss_curve(ALEXNET_NET + "eta = 0.01\nmomentum = 0.9\n",
+                        batch=256, steps=200, nclass=1000,
+                        shape=(3, 227, 227))
+    record("imagenet-alexnet",
+           "synthetic 1000-class (8x8 spatial prototypes + noise), "
+           "b256, 200 steps, TPU v5e, bf16",
+           "softmax loss at steps [1, 50, 100, 150, 200]",
+           {s: round(curve[s - 1], 4) for s in (1, 50, 100, 150, 200)})
+    assert curve[-1] < curve[0] * 0.5, (curve[0], curve[-1])
+
+
+def run_googlenet():
+    from cxxnet_tpu.models import googlenet
+    curve = _loss_curve(
+        googlenet() + "metric = error\neta = 0.05\nmomentum = 0.9\n",
+        batch=128, steps=120, nclass=1000, shape=(3, 224, 224))
+    record("imagenet-googlenet",
+           "synthetic 1000-class (8x8 spatial prototypes + noise), "
+           "b128, 120 steps, TPU v5e, bf16",
+           "summed softmax losses (main+aux) at steps [1, 40, 80, 120]",
+           {s: round(curve[s - 1], 4) for s in (1, 40, 80, 120)})
+    assert curve[-1] < curve[0] * 0.7, (curve[0], curve[-1])
+
+
+def run_dist():
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(ROOT, "tests", "test_distributed.py"), "-x", "-q",
+         "-s"],
+        capture_output=True, text=True, cwd=ROOT, timeout=1800)
+    assert p.returncode == 0, p.stdout[-2000:]
+    record("mnist-dp-2proc",
+           "two-process CPU data parallel (tests/test_distributed.py): "
+           "bit-identical replica checkpoints + identical metric lines, "
+           "incl. kill-and-continue resume",
+           "suite", "passed")
+
+
+if __name__ == "__main__":
+    {"mnist": run_mnist, "imagenet": run_imagenet,
+     "googlenet": run_googlenet, "dist": run_dist}[sys.argv[1]]()

@@ -18,18 +18,37 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
-def run_traced(tracedir, batch=1024, scan_len=6):
+def run_traced(tracedir, batch=1024, scan_len=6, model="alexnet"):
     from __graft_entry__ import ALEXNET_NET, _make_trainer
-    t = _make_trainer(ALEXNET_NET, batch, "tpu",
+    if model == "alexnet":
+        conf, shape = ALEXNET_NET, (3, 227, 227)
+    else:
+        from cxxnet_tpu.models import googlenet
+        conf = googlenet() + "metric = error\neta = 0.01\nmomentum = 0.9\n" \
+            "silent = 1\n"
+        shape = (3, 224, 224)
+    t = _make_trainer(conf, batch, "tpu",
                       extra=[("dtype", "bfloat16"), ("eval_train", "0")])
     rnd = np.random.RandomState(0)
     datas = jnp.asarray(
-        rnd.rand(scan_len, batch, 3, 227, 227).astype(np.float32)
+        rnd.rand(scan_len, batch, *shape).astype(np.float32)
     ).astype(jnp.bfloat16)
     labels = jnp.asarray(
         rnd.randint(0, 1000, (scan_len, batch, 1)).astype(np.float32))
     t.start_round(1)
     np.asarray(t.update_many(datas, labels))  # compile+warm
+    import time
+    t0 = time.perf_counter()
+    np.asarray(t.update_many(datas, labels))
+    wall = (time.perf_counter() - t0) / scan_len * 1e3
+    from bench import conv_flops_per_image, PEAK_FLOPS
+    flops = conv_flops_per_image(t.net)
+    dev = jax.devices()[0].device_kind
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev), 197e12)
+    mfu = 3.0 * flops * (batch / (wall / 1e3)) / peak
+    print(f"{model} b{batch}: wall {wall:.1f} ms/step, "
+          f"{batch / (wall / 1e3):.0f} imgs/sec, fwd {flops/1e9:.2f} "
+          f"GF/img, analytic MFU {mfu*100:.1f}%")
     jax.profiler.start_trace(tracedir)
     np.asarray(t.update_many(datas, labels))
     jax.profiler.stop_trace()
@@ -78,7 +97,8 @@ def parse(tracedir, nsteps):
 
 if __name__ == "__main__":
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-    tracedir = f"/tmp/cxprof_b{batch}"
+    model = sys.argv[2] if len(sys.argv) > 2 else "alexnet"
+    tracedir = f"/tmp/cxprof_{model}_b{batch}"
     os.system(f"rm -rf {tracedir}")
-    n = run_traced(tracedir, batch)
+    n = run_traced(tracedir, batch, model=model)
     parse(tracedir, n)

@@ -25,9 +25,12 @@
 
 #include <algorithm>
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <memory>
+#include <mutex>
 #include <random>
 #include <string>
 #include <thread>
@@ -122,6 +125,14 @@ class ImbinIterator {
     seed_data_ = cfg.GetInt("seed_data", 0);
     scale_ = cfg.GetFloat("scale", 1.0);
     silent_ = cfg.GetInt("silent", 0);
+    // decode fan-out (reference iter_thread_imbin_x decoder threads);
+    // 0 = decode inline on the producer.  Default: half the cores — jpeg
+    // decode at ~1-3 ms/image single-threaded cannot feed a ~20k imgs/sec
+    // training step
+    long hw = (long)std::thread::hardware_concurrency();
+    decode_threads_ = cfg.GetInt("decode_thread_num",
+                                 hw > 1 ? hw / 2 : 0);
+    if (decode_threads_ > 0) StartPool();
     mean_.assign(c_, 0.f);
     {
       std::string mv = cfg.Get("mean_value");
@@ -271,6 +282,12 @@ class ImbinIterator {
     ++gen_;
     queue_.Reset(gen_);
     if (producer_.joinable()) producer_.join();
+    {
+      std::lock_guard<std::mutex> l(jobs_m_);
+      pool_shutdown_ = true;
+    }
+    jobs_cv_.notify_all();
+    for (auto& t : pool_) t.join();
   }
 
  private:
@@ -299,115 +316,224 @@ class ImbinIterator {
     return true;
   }
 
-  // producer thread: stream pages -> instances -> batches
-  void Produce(uint64_t gen) {
-    std::mt19937_64 rng(787 + seed_data_ + gen);
+  // Stream shards/pages in (shuffled) order, calling
+  // fn(rec_bytes, global_index) per record; returns false on error or
+  // generation change (run_err_ set on error).
+  template <class FnRecord>
+  bool StreamRecords(uint64_t gen, std::mt19937_64& rng, FnRecord&& fn) {
     std::vector<size_t> shard_order(bins_.size());
     for (size_t i = 0; i < shard_order.size(); ++i) shard_order[i] = i;
     if (shuffle_) std::shuffle(shard_order.begin(), shard_order.end(), rng);
-    // global label offset of each shard
-    std::vector<size_t> shard_off(bins_.size() + 1, 0);
-    // all shards' label counts were read in shard order; recover per-shard
-    // counts by streaming page headers would be wasteful, so instead track
-    // positions while reading (bins and lsts pair 1:1)
-    // -> simpler: recompute from lst line counts at init? We already have
-    //    only the concatenated labels; track during Produce by counting
-    //    records per shard and asserting totals at the end.
-    Batch cur;
-    cur.data.resize((size_t)batch_size_ * inst_size());
-    cur.label.resize((size_t)batch_size_ * label_width_);
-    cur.index.resize(batch_size_);
-    size_t top = 0;          // filled rows in cur
-    size_t pos = 0;          // global instance cursor (label pairing)
-    bool ok = true;
-    // head cache for round_batch wrap (first batch_size instances)
-    std::vector<float> head_data;
-    std::vector<float> head_label;
-    std::vector<uint64_t> head_index;
-    size_t head_n = 0;
-    head_data.resize((size_t)batch_size_ * inst_size());
-    head_label.resize((size_t)batch_size_ * label_width_);
-    head_index.resize(batch_size_);
-
-    for (size_t so = 0; so < shard_order.size() && ok; ++so) {
+    for (size_t so = 0; so < shard_order.size(); ++so) {
       size_t b = shard_order[so];
       // shard b's labels start at offset = sum of record counts of shards
       // before b in file order (counted from the .lst files at Init; a
-      // bin/lst count mismatch is caught by the per-record gidx bound and
-      // the end-of-shard check below)
+      // bin/lst count mismatch is caught by the end-of-shard check below)
       size_t off = 0;
       for (size_t i = 0; i < b; ++i) off += shard_rec_count_[i];
-      pos = off;
+      size_t pos = off;
       BinPageReader rd;
       std::string err;
-      if (!rd.Open(bins_[b], &err)) { run_err_ = err; ok = false; break; }
+      if (!rd.Open(bins_[b], &err)) { run_err_ = err; return false; }
       Page page;
-      while (ok) {
-        if (queue_.gen() != gen) return;  // orphaned
+      while (true) {
+        if (queue_.gen() != gen) return false;  // orphaned
         if (!rd.NextPage(&page, &err)) {
-          if (!err.empty()) { run_err_ = err; ok = false; }
+          if (!err.empty()) { run_err_ = err; return false; }
           break;
         }
         if (pos + page.recs.size() > off + shard_rec_count_[b]) {
           run_err_ = bins_[b] + ": more records than its list has entries";
-          ok = false;
-          break;
+          return false;
         }
         std::vector<uint32_t> order(page.recs.size());
         for (uint32_t i = 0; i < order.size(); ++i) order[i] = i;
         if (shuffle_) std::shuffle(order.begin(), order.end(), rng);
         for (uint32_t oi = 0; oi < order.size(); ++oi) {
           uint32_t ri = order[oi];
-          size_t gidx = pos + ri;
-          float* drow = cur.data.data() + top * inst_size();
-          if (!DecodeInto(page.recs[ri], drow)) {
-            run_err_ = "record decode failed (size/format mismatch)";
-            ok = false;
-            break;
-          }
-          std::memcpy(cur.label.data() + top * label_width_,
-                      labels_.data() + gidx * label_width_,
-                      label_width_ * sizeof(float));
-          cur.index[top] = indices_[gidx];
-          if (head_n < (size_t)batch_size_) {
-            std::memcpy(head_data.data() + head_n * inst_size(), drow,
-                        inst_size() * sizeof(float));
-            std::memcpy(head_label.data() + head_n * label_width_,
-                        cur.label.data() + top * label_width_,
-                        label_width_ * sizeof(float));
-            head_index[head_n] = cur.index[top];
-            ++head_n;
-          }
-          if (++top == (size_t)batch_size_) {
-            Batch out;
-            out.data = cur.data;
-            out.label = cur.label;
-            out.index = cur.index;
-            if (!queue_.Push(std::move(out), gen)) return;
-            top = 0;
-          }
+          // each record is visited exactly once; hand it over by value so
+          // the pooled path can move it into its decode job copy-free
+          if (!fn(std::move(page.recs[ri]), pos + ri)) return false;
         }
         pos += page.recs.size();
       }
     }
+    return true;
+  }
+
+  // A batch under construction on the decode pool: jobs decrement
+  // `remaining`; the producer waits for 0 before pushing.  Heap-held via
+  // shared_ptr so stale jobs of an abandoned generation stay safe.
+  struct DecodeSlot {
+    Batch batch;
+    std::atomic<int> remaining{0};
+    std::atomic<bool> failed{false};
+    std::mutex m;
+    std::condition_variable cv;
+    void Done() {
+      if (remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> l(m);
+        cv.notify_all();
+      }
+    }
+    void Wait() {
+      std::unique_lock<std::mutex> l(m);
+      cv.wait(l, [&] { return remaining.load() == 0; });
+    }
+  };
+
+  struct DecodeJob {
+    std::vector<char> rec;
+    std::shared_ptr<DecodeSlot> slot;
+    size_t row = 0;
+    uint64_t gen = 0;
+  };
+
+  void StartPool() {
+    pool_shutdown_ = false;
+    for (long i = 0; i < decode_threads_; ++i)
+      pool_.emplace_back([this] { PoolWorker(); });
+  }
+
+  void PoolWorker() {
+    for (;;) {
+      DecodeJob job;
+      {
+        std::unique_lock<std::mutex> l(jobs_m_);
+        jobs_cv_.wait(l, [&] { return pool_shutdown_ || !jobs_.empty(); });
+        if (pool_shutdown_ && jobs_.empty()) return;
+        job = std::move(jobs_.front());
+        jobs_.pop_front();
+      }
+      // stale generations skip the decode but still release the slot
+      if (job.gen == gen_.load()) {
+        float* out = job.slot->batch.data.data() + job.row * inst_size();
+        if (!DecodeInto(job.rec, out)) job.slot->failed = true;
+      }
+      job.slot->Done();
+    }
+  }
+
+  void Dispatch(std::vector<char>&& rec,
+                const std::shared_ptr<DecodeSlot>& slot, size_t row,
+                uint64_t gen) {
+    slot->remaining.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> l(jobs_m_);
+      jobs_.push_back(DecodeJob{std::move(rec), slot, row, gen});
+    }
+    jobs_cv_.notify_one();
+  }
+
+  std::shared_ptr<DecodeSlot> NewSlot() {
+    auto s = std::make_shared<DecodeSlot>();
+    s->batch.data.resize((size_t)batch_size_ * inst_size());
+    s->batch.label.resize((size_t)batch_size_ * label_width_);
+    s->batch.index.resize(batch_size_);
+    return s;
+  }
+
+  // producer thread: stream pages -> instances -> batches.  With a decode
+  // pool, the producer only parses pages and copies labels; jpeg decode +
+  // normalization fan out over `decode_thread_num` workers, two batches in
+  // flight (dispatch batch k+1 while batch k finishes decoding) — the
+  // reference's dedicated decoder-thread design
+  // (iter_thread_imbin_x-inl.hpp:304-330) without its fixed 1:1 pairing.
+  void Produce(uint64_t gen) {
+    std::mt19937_64 rng(787 + seed_data_ + gen);
+    const bool pooled = decode_threads_ > 0;
+    // head cache for round_batch wrap (first batch_size instances)
+    std::vector<float> head_data((size_t)batch_size_ * inst_size());
+    std::vector<float> head_label((size_t)batch_size_ * label_width_);
+    std::vector<uint64_t> head_index(batch_size_);
+    size_t head_n = 0;
+
+    std::shared_ptr<DecodeSlot> cur = NewSlot();
+    std::shared_ptr<DecodeSlot> in_flight;  // fully dispatched, decoding
+    size_t top = 0;
+    bool ok = true;
+
+    auto cache_head = [&](const Batch& b) {
+      if (head_n) return;
+      std::memcpy(head_data.data(), b.data.data(),
+                  head_data.size() * sizeof(float));
+      std::memcpy(head_label.data(), b.label.data(),
+                  head_label.size() * sizeof(float));
+      std::copy(b.index.begin(), b.index.end(), head_index.begin());
+      head_n = batch_size_;
+    };
+    // wait for a dispatched slot's decodes, cache the head, push it
+    auto finish = [&](std::shared_ptr<DecodeSlot> s) -> bool {
+      s->Wait();
+      if (s->failed.load()) {
+        run_err_ = "record decode failed (size/format mismatch)";
+        return false;
+      }
+      cache_head(s->batch);
+      return queue_.Push(std::move(s->batch), gen);
+    };
+
+    ok = StreamRecords(gen, rng, [&](std::vector<char>&& rec,
+                                     size_t gidx) {
+      Batch& b = cur->batch;
+      std::memcpy(b.label.data() + top * label_width_,
+                  labels_.data() + gidx * label_width_,
+                  label_width_ * sizeof(float));
+      b.index[top] = indices_[gidx];
+      if (pooled) {
+        Dispatch(std::move(rec), cur, top, gen);
+      } else if (!DecodeInto(rec, b.data.data() + top * inst_size())) {
+        run_err_ = "record decode failed (size/format mismatch)";
+        return false;
+      }
+      if (++top == (size_t)batch_size_) {
+        top = 0;
+        if (in_flight && !finish(std::move(in_flight))) return false;
+        in_flight = std::move(cur);
+        cur = NewSlot();
+        if (!pooled) {
+          // no pool: the batch is already decoded; push immediately
+          if (!finish(std::move(in_flight))) return false;
+        }
+      }
+      return true;
+    });
+    if (ok && in_flight) ok = finish(std::move(in_flight));
+
     // tail: wrap with head instances if round_batch (batch adapter parity)
     if (ok && top > 0 && round_batch_) {
-      size_t need = batch_size_ - top;
-      if (need <= head_n) {
-        for (size_t i = 0; i < need; ++i) {
-          std::memcpy(cur.data.data() + (top + i) * inst_size(),
-                      head_data.data() + i * inst_size(),
-                      inst_size() * sizeof(float));
-          std::memcpy(cur.label.data() + (top + i) * label_width_,
-                      head_label.data() + i * label_width_,
-                      label_width_ * sizeof(float));
-          cur.index[top + i] = head_index[i];
-        }
-        cur.num_batch_padd = need;
-        Batch out = std::move(cur);
-        if (!queue_.Push(std::move(out), gen)) return;
+      cur->Wait();
+      Batch& b = cur->batch;
+      if (cur->failed.load()) {
+        run_err_ = "record decode failed (size/format mismatch)";
       } else {
-        run_err_ = "round_batch: dataset smaller than batch";
+        if (head_n == 0) {
+          // dataset smaller than one batch: the tail rows ARE the stream's
+          // first instances — they serve as the wrap head
+          std::memcpy(head_data.data(), b.data.data(),
+                      top * inst_size() * sizeof(float));
+          std::memcpy(head_label.data(), b.label.data(),
+                      top * label_width_ * sizeof(float));
+          std::copy(b.index.begin(), b.index.begin() + top,
+                    head_index.begin());
+          head_n = top;
+        }
+        size_t need = batch_size_ - top;
+        if (need <= head_n) {
+          for (size_t i = 0; i < need; ++i) {
+            std::memcpy(b.data.data() + (top + i) * inst_size(),
+                        head_data.data() + i * inst_size(),
+                        inst_size() * sizeof(float));
+            std::memcpy(b.label.data() + (top + i) * label_width_,
+                        head_label.data() + i * label_width_,
+                        label_width_ * sizeof(float));
+            b.index[top + i] = head_index[i];
+          }
+          b.num_batch_padd = need;
+          if (!queue_.Push(std::move(b), gen)) return;
+        } else {
+          run_err_ = "round_batch: dataset smaller than batch";
+        }
       }
     }
     Batch sentinel;
@@ -417,6 +543,12 @@ class ImbinIterator {
 
   int batch_size_ = 0, c_ = 0, h_ = 0, w_ = 0, label_width_ = 1;
   long shuffle_ = 0, round_batch_ = 0, seed_data_ = 0, silent_ = 0;
+  long decode_threads_ = 0;
+  std::vector<std::thread> pool_;
+  std::deque<DecodeJob> jobs_;
+  std::mutex jobs_m_;
+  std::condition_variable jobs_cv_;
+  bool pool_shutdown_ = false;
   double scale_ = 1.0;
   std::vector<float> mean_;
   std::vector<std::string> bins_, lsts_;

@@ -455,6 +455,24 @@ def test_diff_layers_harness():
     assert d["in_grad_rel_err"] > 1e-3
 
 
+def test_max_pool_bwd_gather_matches_dilate():
+    """The candidate-window gather unpool (CXXNET_POOL_BWD=gather) equals
+    the dilate-and-add formulation on strided/padded/tail geometries."""
+    from cxxnet_tpu.ops import nn as N
+    rnd = np.random.RandomState(0)
+    for (h, w, k, s, p) in [(55, 55, 3, 2, 0), (13, 13, 3, 2, 0),
+                            (28, 28, 2, 2, 0), (27, 27, 3, 1, 1),
+                            (9, 9, 3, 3, 0), (8, 10, 4, 3, 2)]:
+        x = jnp.asarray(rnd.randint(0, 5, (2, 3, h, w)).astype(np.float32))
+        y = N._max_pool_raw(x, k, k, s, p, p)
+        dy = jnp.asarray(rnd.rand(*y.shape).astype(np.float32))
+        d1 = N._max_pool_eq_bwd(k, k, s, p, p, (x, y), dy)[0]
+        d2 = N._max_pool_eq_bwd_gather(k, k, s, p, p, (x, y), dy)[0]
+        np.testing.assert_allclose(np.asarray(d2), np.asarray(d1),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=str((h, w, k, s, p)))
+
+
 def test_conv2d_s2d_matches_conv2d():
     """Space-to-depth lowering is numerically the same conv (fwd + grads)."""
     import jax
