@@ -182,7 +182,7 @@ class NetTrainer:
                 return out
             self.hypers[pkey] = make_hypers(group)
         self.opt_state = _map_group(
-            self.params, lambda tag, p: self.updater.init_state(p))
+            self.params, lambda tag, p: self.updater.make_state(p))
         # eval-node requests (metric[label,node]); "" -> final node
         self.eval_node_ids = []
         for (_, _, node) in self._metric_req:
@@ -585,6 +585,24 @@ class NetTrainer:
             f"set_weight: shape mismatch {old.shape} vs {value.shape}"
         self.params[pkey][tag] = jax.device_put(
             jnp.asarray(value, old.dtype), self.param_shardings[pkey][tag])
+        self._refresh_masters(pkey)
+
+    def _refresh_masters(self, pkey: Optional[str] = None) -> None:
+        """Re-derive the optimizer's float32 master copies (``w32``) from
+        the current params.  MUST follow any direct param write
+        (set_weight / copy_model_from): the update step sources from the
+        master, so a stale one would silently revert the written weights
+        on the next update."""
+        def rec(group, state):
+            for tag, p in group.items():
+                if isinstance(p, dict):
+                    rec(p, state[tag])
+                elif isinstance(state.get(tag), dict) and "w32" in state[tag]:
+                    # the jitted step reshards this to the opt sharding on
+                    # its next invocation (in_shardings are explicit)
+                    state[tag]["w32"] = p.astype(jnp.float32)
+        for k in ([pkey] if pkey else list(self.params.keys())):
+            rec(self.params[k], self.opt_state[k])
 
     # ---------------------------------------------------------- checkpoints
     def save_model(self, path: str, *, with_opt_state: bool = False) -> None:
@@ -640,6 +658,7 @@ class NetTrainer:
                         {t: jnp.asarray(src[t], group[t].dtype)
                          for t in group},
                         self.param_shardings[pkey])
+                    self._refresh_masters(pkey)
                     copied.append(name)
         if not self.silent:
             print(f"copy_model_from: copied layers {copied}")

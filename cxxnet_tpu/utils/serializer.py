@@ -21,20 +21,35 @@ import numpy as np
 FORMAT_VERSION = 1
 
 
-def _flatten(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
+def _flatten(tree: Dict, prefix: str = "",
+             dtypes: Dict[str, str] = None) -> Dict[str, np.ndarray]:
     out = {}
     for k, v in tree.items():
         key = f"{prefix}{k}"
         if isinstance(v, dict):
-            out.update(_flatten(v, key + "/"))
+            out.update(_flatten(v, key + "/", dtypes))
         else:
-            out[key] = np.asarray(v)
+            a = np.asarray(v)
+            if a.dtype.kind not in "fiub":
+                # numpy's npz container cannot round-trip ml_dtypes
+                # extension types (bfloat16 reloads as void "|V2"): store
+                # as float32 (exact — bf16 is a truncated f32) and record
+                # the original dtype so load restores it
+                name = a.dtype.name
+                a = a.astype(np.float32)
+                if dtypes is not None:
+                    dtypes[key] = name
+            out[key] = a
     return out
 
 
-def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
+def _unflatten(flat: Dict[str, np.ndarray],
+               dtypes: Dict[str, str] = None) -> Dict:
     tree: Dict = {}
     for key, v in flat.items():
+        if dtypes and key in dtypes:
+            import jax.numpy as jnp
+            v = jnp.asarray(v, dtype=dtypes[key])
         parts = key.split("/")
         d = tree
         for p in parts[:-1]:
@@ -46,21 +61,22 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
 def save_model(path: str, *, net_structure: dict, epoch: int,
                params: Dict, buffers: Dict, opt_state: Dict = None,
                extra_meta: Dict = None) -> None:
+    dtypes: Dict[str, str] = {}
+    arrays: Dict[str, np.ndarray] = {}
+    arrays.update(_flatten({"params": params}, dtypes=dtypes))
+    arrays.update(_flatten({"buffers": buffers}, dtypes=dtypes))
+    if opt_state is not None:
+        arrays.update(_flatten({"opt": opt_state}, dtypes=dtypes))
     header = {
         "format_version": FORMAT_VERSION,
         "net": net_structure,
         "epoch": int(epoch),
         "has_opt_state": opt_state is not None,
+        "dtypes": dtypes,
         "extra": extra_meta or {},
     }
-    arrays: Dict[str, np.ndarray] = {
-        "__header__": np.frombuffer(
-            json.dumps(header).encode("utf-8"), dtype=np.uint8),
-    }
-    arrays.update(_flatten({"params": params}))
-    arrays.update(_flatten({"buffers": buffers}))
-    if opt_state is not None:
-        arrays.update(_flatten({"opt": opt_state}))
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode("utf-8"), dtype=np.uint8)
     with open(path, "wb") as f:
         np.savez(f, **arrays)
 
@@ -70,7 +86,7 @@ def load_model(path: str) -> Tuple[dict, Dict, Dict, Dict]:
     with np.load(path, allow_pickle=False) as z:
         header = json.loads(bytes(z["__header__"]).decode("utf-8"))
         flat = {k: z[k] for k in z.files if k != "__header__"}
-    tree = _unflatten(flat)
+    tree = _unflatten(flat, header.get("dtypes"))
     params = tree.get("params", {})
     buffers = tree.get("buffers", {})
     opt = tree.get("opt") if header.get("has_opt_state") else None

@@ -1,0 +1,60 @@
+"""Throughput numbers for the wider model zoo (VGG-16, ResNet).
+
+python experiments/model_bench.py vgg16|resnet20|resnet56
+Prints step ms + imgs/sec + analytic MFU on the TPU.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main(which):
+    from __graft_entry__ import _make_trainer
+    from cxxnet_tpu.models import resnet, vgg
+    from bench import conv_flops_per_image, PEAK_FLOPS
+    if which == "vgg16":
+        conf = vgg(depth=16) + "metric = error\neta = 0.01\nmomentum = 0.9\n"
+        batch, shape = 128, (3, 224, 224)
+    elif which.startswith("resnet"):
+        depth = int(which[6:])
+        conf = resnet(num_class=10, depth=depth) + \
+            "metric = error\neta = 0.1\nmomentum = 0.9\n"
+        batch, shape = 1024, (3, 32, 32)
+    else:
+        raise SystemExit(f"unknown model {which}")
+    nclass = 1000 if which == "vgg16" else 10
+    t = _make_trainer(conf, batch, "tpu",
+                      extra=[("dtype", "bfloat16"), ("eval_train", "0"),
+                             ("silent", "1")])
+    rnd = np.random.RandomState(0)
+    k, trials = 10, 2
+    datas = jnp.asarray(rnd.rand(k, batch, *shape).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+    labels = jnp.asarray(
+        rnd.randint(0, nclass, (k, batch, 1)).astype(np.float32))
+    t.start_round(1)
+    np.asarray(t.update_many(datas, labels))
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        losses = t.update_many(datas, labels)
+    np.asarray(losses)
+    dt = time.perf_counter() - t0
+    step_ms = dt / (k * trials) * 1e3
+    ips = batch * k * trials / dt
+    flops = conv_flops_per_image(t.net)
+    dev = jax.devices()[0].device_kind
+    peak = next((v for kk, v in PEAK_FLOPS.items() if kk in dev), 197e12)
+    mfu = 3.0 * flops * ips / peak
+    print(f"{which} b{batch}: step={step_ms:.2f}ms imgs/sec={ips:.0f} "
+          f"fwd={flops/1e9:.2f}GF/img MFU={mfu*100:.1f}%")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

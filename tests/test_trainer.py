@@ -249,6 +249,100 @@ silent = 1
     assert losses[-1] < losses[0] * 0.5, f"loss did not drop: {losses[:3]} -> {losses[-3:]}"
 
 
+def test_bf16_checkpoint_roundtrip(tmp_path):
+    """bfloat16 params survive save/load (numpy's npz cannot round-trip
+    ml_dtypes extension types — the serializer stores them as exact float32
+    and restores the dtype from the header)."""
+    import jax.numpy as jnp
+    t = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                      ("dtype", "bfloat16")])
+    t.start_round(1)
+    for b in synth_batches(3):
+        t.update(b)
+    path = str(tmp_path / "m.model")
+    t.save_model(path, with_opt_state=True)
+    t2 = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                       ("dtype", "bfloat16")])
+    t2.load_model(path)
+    for pkey, group in t.params.items():
+        for tag, p in group.items():
+            q = t2.params[pkey][tag]
+            assert q.dtype == jnp.bfloat16, (pkey, tag, q.dtype)
+            np.testing.assert_array_equal(
+                np.asarray(p, np.float32), np.asarray(q, np.float32))
+    # master copies restored with the optimizer state
+    leaf = next(iter(t2.opt_state.values()))
+    tagstate = next(iter(leaf.values()))
+    assert "w32" in tagstate
+
+
+def test_bf16_finetune_weights_survive_update(tmp_path):
+    """copy_model_from / set_weight on a bf16 model must refresh the f32
+    master copies — otherwise the first optimizer step reverts the written
+    weights to (stale master) - lr*grad."""
+    t = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                      ("dtype", "bfloat16")])
+    batches = synth_batches(4)
+    t.start_round(1)
+    for b in batches:
+        t.update(b)
+    path = str(tmp_path / "pre.model")
+    t.save_model(path)
+    t2 = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                       ("dtype", "bfloat16"),
+                                       ("seed", "9"), ("eta", "1e-6")])
+    t2.copy_model_from(path)
+    w_copied = t2.get_weight("fc1", "wmat").astype(np.float32)
+    t2.start_round(1)
+    t2.update(batches[0])  # tiny lr: weights must stay ~at the copied values
+    w_after = t2.get_weight("fc1", "wmat").astype(np.float32)
+    assert np.abs(w_after - w_copied).max() < 1e-3, \
+        np.abs(w_after - w_copied).max()
+    # set_weight path too
+    val = np.full_like(w_copied, 0.25)
+    t2.set_weight(val, "fc1", "wmat")
+    t2.update(batches[1])
+    w3 = t2.get_weight("fc1", "wmat").astype(np.float32)
+    assert np.abs(w3 - 0.25).max() < 1e-3, np.abs(w3 - 0.25).max()
+
+
+def test_bf16_master_weights_accumulate_small_updates():
+    """bf16 params carry an f32 master copy in the optimizer state: many
+    updates each below bf16's mantissa resolution must still accumulate
+    (without the master, w += m rounds to nothing and training stalls —
+    the AlexNet bf16 plateau found in round 2)."""
+    from cxxnet_tpu.updater import create_updater, UpdaterHyper
+    import jax.numpy as jnp
+    u = create_updater("sgd")
+    h = UpdaterHyper()
+    h.base_lr, h.momentum = 1e-4, 0.0
+    p = jnp.full((8,), 1.0, jnp.bfloat16)
+    s = u.make_state(p)
+    assert "w32" in s
+    g = jnp.full((8,), 1.0, jnp.float32)  # step 1e-4 << bf16 eps at 1.0
+    for i in range(64):
+        p, s = u.apply(p, g, s, h, i)
+    # 64 * 1e-4 = 6.4e-3: visible in bf16 only because the master carried it
+    assert float(p[0].astype(jnp.float32)) < 0.999, float(p[0])
+    np.testing.assert_allclose(float(s["w32"][0]), 1.0 - 64e-4, rtol=1e-5)
+    # float32 params take no master copy
+    assert "w32" not in u.make_state(jnp.ones((4,), jnp.float32))
+
+
+def test_bf16_trainer_converges_with_small_lr():
+    """End-to-end: a bf16 model with a small learning rate keeps making
+    progress (master-weight path through the jitted step)."""
+    t = make_trainer(MLP_CONF, extra=[("silent", "1"),
+                                      ("dtype", "bfloat16"),
+                                      ("eta", "0.02"), ("momentum", "0.9")])
+    batches = synth_batches()
+    t.start_round(1)
+    for _ in range(8):
+        for b in batches:
+            t.update(b)
+    assert accuracy(t, batches) > 0.9
+
+
 def test_nag_and_adam_updaters():
     for upd in ("nag", "adam"):
         conf = MLP_CONF + f"\nupdater = {upd}\n"
