@@ -1,0 +1,150 @@
+"""Mixture-of-experts layer with expert-parallel sharding.
+
+No reference counterpart (the reference predates MoE; SURVEY.md §5.7 treats
+long-context/scale substrates as design obligations of this framework).
+Switch-transformer-style top-1 routing with fixed expert capacity: shapes
+stay static under jit, and on a mesh with an ``expert`` axis the per-expert
+FFN weights shard over it — GSPMD turns the dispatch/combine einsums into
+all-to-alls over ICI, which IS expert parallelism.
+
+Config::
+
+    layer[+1] = moe
+      num_expert = 8
+      nhidden = 2048            # expert FFN width
+      capacity_factor = 1.25    # per-expert slots = cf * tokens / E
+      moe_alpha = 0.01          # load-balance aux loss weight
+
+Forward (tokens t = batch*seq, model dim d, experts e, capacity c):
+  gate probs (t, e) -> top-1 expert + position-in-expert via cumsum;
+  dispatch  x_e = einsum('tec,td->ecd', D, x)      (all-to-all on e)
+  expert FFN x_e @ w1[e] -> gelu -> @ w2[e]        (batched per-expert MXU)
+  combine   y  = einsum('ecd,tec->td', y_e, D * p) (all-to-all back)
+Tokens beyond an expert's capacity are dropped (standard Switch behavior:
+their residual path carries them).  The Switch load-balancing aux loss
+alpha * E * sum_e f_e * P_e is appended to ctx.losses.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .base import ForwardContext, Layer, Shape4
+
+
+def _expert_mesh(ctx: ForwardContext):
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is not None and "expert" in mesh.axis_names \
+            and mesh.shape["expert"] > 1:
+        return mesh
+    return None
+
+
+class MoELayer(Layer):
+    type_names = ("moe",)
+
+    def __init__(self):
+        super().__init__()
+        self.num_expert = 0
+        self.capacity_factor = 1.25
+        self.moe_alpha = 0.01
+
+    def set_param(self, name, val):
+        if name == "num_expert":
+            self.num_expert = int(val)
+        elif name == "capacity_factor":
+            self.capacity_factor = float(val)
+        elif name == "moe_alpha":
+            self.moe_alpha = float(val)
+        else:
+            super().set_param(name, val)
+
+    def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        assert len(in_shapes) == 1, "moe: 1-1 connection only"
+        assert self.num_expert > 1, "moe: set num_expert"
+        assert self.param.num_hidden > 0, "moe: set nhidden (FFN width)"
+        return [in_shapes[0]]
+
+    def _capacity(self, tokens: int) -> int:
+        return max(1, int(self.capacity_factor * tokens / self.num_expert))
+
+    def init_params(self, key, in_shapes, dtype=jnp.float32):
+        d = in_shapes[0][3]
+        e, h = self.num_expert, self.param.num_hidden
+        ks = jax.random.split(key, 3)
+        p = self.param
+        return {
+            "gate": p.rand_init_weight(ks[0], (d, e), d, e, dtype),
+            "wmat": p.rand_init_weight(ks[1], (e, d, h), d, h, dtype),
+            "wmat2": p.rand_init_weight(ks[2], (e, h, d), h, d, dtype),
+            "bias": jnp.full((e, h), p.init_bias, dtype),
+            "bias2": jnp.full((e, d), p.init_bias, dtype),
+        }
+
+    def forward(self, params, buffers, inputs, ctx):
+        self.check_n_inputs(inputs, 1)
+        x4 = inputs[0]                       # (b, 1, s, d)
+        b, _, s, d = x4.shape
+        e = self.num_expert
+        t = b * s
+        c = self._capacity(t)
+        x = x4.reshape(t, d)
+
+        # top-1 routing in f32 (gate numerics should not depend on dtype)
+        logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)          # (t, e)
+        expert = jnp.argmax(probs, axis=-1)              # (t,)
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+        gate_p = jnp.sum(probs * onehot, axis=-1)        # (t,)
+
+        # position of each token within its expert; beyond-capacity drops
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (t, e)
+        pos_tok = jnp.sum(pos, axis=-1)                    # (t,)
+        keep = pos_tok < c
+        disp = onehot * keep[:, None]                    # (t, e)
+        slot = jax.nn.one_hot(pos_tok, c, dtype=jnp.float32)  # (t, c)
+        dmat = disp[:, :, None] * slot[:, None, :]       # (t, e, c)
+        dmat = dmat.astype(x.dtype)
+
+        mesh = _expert_mesh(ctx)
+
+        def eshard(a, spec):
+            if mesh is None:
+                return a
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec))
+
+        # dispatch: (t, e, c) x (t, d) -> (e, c, d); sharding the e axis
+        # makes GSPMD emit the all-to-all over the expert mesh axis
+        xe = jnp.einsum("tec,td->ecd", dmat, x)
+        xe = eshard(xe, P("expert", None, None))
+        w1 = eshard(params["wmat"].astype(x.dtype), P("expert", None, None))
+        w2 = eshard(params["wmat2"].astype(x.dtype), P("expert", None, None))
+        b1 = eshard(params["bias"].astype(x.dtype), P("expert", None))
+        b2 = eshard(params["bias2"].astype(x.dtype), P("expert", None))
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, w1)
+                        + b1[:, None, :])
+        ye = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        ye = eshard(ye, P("expert", None, None))
+        # combine, weighted by the gate probability (straight-through on
+        # the routing, differentiable through the prob)
+        comb = dmat * gate_p.astype(x.dtype)[:, None, None]
+        y = jnp.einsum("ecd,tec->td", ye, comb)
+        # dropped tokens ride the residual
+        y = y + jnp.where(keep[:, None], jnp.zeros((), x.dtype), x)
+
+        if ctx.train and self.moe_alpha > 0:
+            # Switch aux loss: E * sum_e (fraction routed)*(mean prob) —
+            # already a batch statistic, so scale by loss_scale*b
+            # (= 1/update_period): its weight must stay O(moe_alpha)
+            # regardless of sequence length
+            frac = jnp.mean(onehot, axis=0)
+            meanp = jnp.mean(probs, axis=0)
+            ctx.losses.append(
+                (self.moe_alpha * e * jnp.sum(frac * meanp)
+                 ).astype(jnp.float32) * ctx.loss_scale * b)
+        return [y.reshape(b, 1, s, d)], buffers

@@ -19,6 +19,7 @@ from .conv import (AvgPoolingLayer, ConvolutionLayer, InsanityPoolingLayer,
                    SumPoolingLayer)
 from .fullc import FixConnectLayer, FullConnectLayer
 from .loss import L2LossLayer, MultiLogisticLayer, SoftmaxLayer
+from .moe import MoELayer
 from .norm import BatchNormLayer, DropoutLayer
 from .pairtest import PairTestLayer
 from .sequence import (AttentionLayer, EmbeddingLayer, LayerNormLayer,
@@ -42,7 +43,7 @@ for _cls in (ReluLayer, SigmoidLayer, TanhLayer, SoftplusLayer, XeluLayer,
              FlattenLayer, SplitLayer, ConcatLayer, ChConcatLayer,
              MaxoutLayer, EltSumLayer, SoftmaxLayer, L2LossLayer,
              MultiLogisticLayer, GeluLayer, EmbeddingLayer, LayerNormLayer,
-             SeqFullcLayer, AttentionLayer, SoftmaxSeqLayer):
+             SeqFullcLayer, AttentionLayer, SoftmaxSeqLayer, MoELayer):
     register(_cls)
 
 

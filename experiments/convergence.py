@@ -112,15 +112,20 @@ def _loss_curve(net_conf, batch, steps, nclass, shape, extra=()):
 def run_imagenet():
     from __graft_entry__ import ALEXNET_NET
     curve = _loss_curve(
-        ALEXNET_NET.replace("eta = 0.01", "eta = 0.002"),
-        batch=256, steps=1000, nclass=1000, shape=(3, 227, 227))
+        ALEXNET_NET.replace("eta = 0.01", "eta = 0.004"),
+        batch=256, steps=1600, nclass=1000, shape=(3, 227, 227))
     record("imagenet-alexnet",
            "synthetic 1000-class (8x8 spatial prototypes + noise), fixed "
-           "2560-sample set, b256, eta 0.002, TPU v5e, bf16",
-           "softmax loss at steps [1, 200, 400, 600, 800, 1000]",
+           "2560-sample set, b256, eta 0.004, TPU v5e, bf16",
+           "softmax loss at steps [1, 400, 800, 1200, 1600]",
            {s: round(curve[s - 1], 4)
-            for s in (1, 200, 400, 600, 800, 1000)})
-    assert curve[-1] < 6.0, (curve[0], curve[-1])
+            for s in (1, 400, 800, 1200, 1600)})
+    # a clear, sustained descent below ln(1000)=6.9078 — NOT the dead-relu
+    # plateau pinned there (the init-inflated curve[0] alone would pass a
+    # relative check)
+    assert curve[-1] < 6.85 and curve[-1] == min(
+        curve[s] for s in (0, 399, 799, 1199, 1599)), \
+        (curve[0], curve[-1])
 
 
 def run_googlenet():
