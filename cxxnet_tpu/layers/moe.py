@@ -106,7 +106,8 @@ class MoELayer(Layer):
         pos_tok = jnp.sum(pos, axis=-1)                    # (t,)
         keep = pos_tok < c
         disp = onehot * keep[:, None]                    # (t, e)
-        slot = jax.nn.one_hot(pos_tok, c, dtype=jnp.float32)  # (t, c)
+        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), c,
+                              dtype=jnp.float32)              # (t, c)
         dmat = disp[:, :, None] * slot[:, None, :]       # (t, e, c)
         dmat = dmat.astype(x.dtype)
 

@@ -207,29 +207,60 @@ class NetTrainer:
         mesh = self.mesh
         self.batch_shard = meshlib.batch_sharding(mesh)
         self.repl = meshlib.replicated(mesh)
+        from ..layers.moe import MoELayer
+        moe_keys = {conn.param_key for conn in self.net.connections
+                    if isinstance(conn.layer, MoELayer)}
 
         def param_spec(pkey: str, tag: str, shape) -> NamedSharding:
             if (self.fullc_gather and "model" in mesh.axis_names
                     and tag == "wmat" and len(shape) == 2
                     and shape[0] % mesh.shape["model"] == 0):
                 return NamedSharding(mesh, P("model", None))
+            if (pkey in moe_keys and "expert" in mesh.axis_names
+                    and tag != "gate"
+                    and shape[0] % mesh.shape["expert"] == 0):
+                # expert-parallel AT REST too: each device keeps only its
+                # experts' weights (and, via opt_shardings following
+                # param leading dims below, their optimizer state) —
+                # the memory benefit of EP, not just the compute
+                return NamedSharding(
+                    mesh, P("expert", *([None] * (len(shape) - 1))))
             return self.repl
 
         self.param_shardings = {
             pkey: _map_group({"": group},
                              lambda tag, p: param_spec(pkey, tag, p.shape))[""]
             for pkey, group in self.params.items()}
-        self.opt_shardings = jax.tree.map(
-            lambda _: self.repl, self.opt_state)
+        # optimizer state inherits its parameter's sharding (same-shaped
+        # leaves: momentum, adam moments, f32 masters) — expert-sharded
+        # MoE weights keep their state expert-sharded too
+        def opt_group(pgroup, sgroup, shgroup):
+            out = {}
+            for tag, p in pgroup.items():
+                if isinstance(p, dict):
+                    out[tag] = opt_group(p, sgroup[tag], shgroup[tag])
+                else:
+                    out[tag] = {k: shgroup[tag]
+                                if getattr(v, "shape", None) == p.shape
+                                else self.repl
+                                for k, v in sgroup[tag].items()}
+            return out
+        self.opt_shardings = {
+            pkey: opt_group(group, self.opt_state[pkey],
+                            self.param_shardings[pkey])
+            for pkey, group in self.params.items()}
         if self.shard_opt_state and "data" in mesh.axis_names:
             ndata = mesh.shape["data"]
 
-            def opt_spec(path_p):
-                p = path_p
-                if p.ndim >= 1 and p.shape[0] % ndata == 0 and p.size >= 2 ** 14:
+            def opt_spec(p, cur):
+                # ZeRO over 'data' for big leaves still replicated after
+                # the inherit pass; an already-sharded leaf keeps its axis
+                if (cur is self.repl and p.ndim >= 1
+                        and p.shape[0] % ndata == 0 and p.size >= 2 ** 14):
                     return NamedSharding(mesh, P("data"))
-                return self.repl
-            self.opt_shardings = jax.tree.map(opt_spec, self.opt_state)
+                return cur
+            self.opt_shardings = jax.tree.map(
+                opt_spec, self.opt_state, self.opt_shardings)
         self.buffer_shardings = jax.tree.map(lambda _: self.repl, self.buffers)
         # place initial state
         self.params = jax.device_put(self.params, self.param_shardings)

@@ -1,0 +1,103 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+No reference counterpart: the reference scales across devices only by data
+parallelism through its parameter server (SURVEY.md §2.8); pipeline
+parallelism is part of this framework's TPU-native scaling surface
+(dp/tp/sp/ep/pp).  The implementation is the canonical SPMD pipeline: each
+device along the ``pipe`` axis owns one stage's parameters (a stacked
+(S, ...) pytree sharded on its leading dim), microbatches enter at stage 0,
+activations rotate stage-to-stage with ``lax.ppermute`` inside a
+``lax.scan`` of ``n_micro + S - 1`` ticks (the pipeline bubble), and
+outputs are collected from the last stage.  Autodiff just works: the
+transpose of ``ppermute`` is the reverse rotation, so ``jax.grad`` of a
+loss over :func:`pipeline_apply` runs the backward pipeline in the same
+schedule — one jitted SPMD program, exactly like every other parallel mode
+here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # deprecated path, removed in newer jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _REP_KW = "check_rep"
+except ImportError:  # pragma: no cover
+    _shard_map = jax.shard_map  # a function on the jax namespace
+    _REP_KW = "check_vma"
+
+
+def shard_map(f, **kw):
+    """Version shim: the replication-check kwarg was renamed
+    check_rep -> check_vma when shard_map left jax.experimental."""
+    if "check_rep" in kw and _REP_KW != "check_rep":
+        kw[_REP_KW] = kw.pop("check_rep")
+    return _shard_map(f, **kw)
+
+
+def stack_stage_params(params_list) -> Any:
+    """[per-stage pytree, ...] -> one pytree with a leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jnp.ndarray,
+                   *, mesh: Mesh, axis: str = "pipe") -> jnp.ndarray:
+    """Run ``x`` through S pipelined stages.
+
+    ``stage_fn(params, mb)``: one stage on one microbatch (shape-preserving
+    across stages so activations can rotate).  ``stacked_params``: leaves
+    (S, ...) — sharded on ``axis`` by the caller (or left to GSPMD).
+    ``x``: (n_micro, mb, ...) microbatched input, replicated over ``axis``.
+    Returns (n_micro, mb, ...) outputs, replicated over ``axis``.
+    """
+    n_stage = mesh.shape[axis]
+    n_micro = x.shape[0]
+    ticks = n_micro + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def spmd(params, xs):
+        # inside shard_map: params leaves (1, ...) = this device's stage
+        p_local = jax.tree.map(lambda a: a[0], params)
+        idx = lax.axis_index(axis)
+
+        def tick(carry, t):
+            state = carry  # (mb, ...) activation arriving at this stage
+            # stage 0 ingests microbatch t (clamped; bubble ticks compute
+            # garbage that is masked out at collection)
+            inject = xs[jnp.clip(t, 0, n_micro - 1)]
+            x_in = jnp.where(idx == 0, inject, state)
+            y = stage_fn(p_local, x_in)
+            return lax.ppermute(y, axis, perm), y
+
+        init = jnp.zeros_like(x[0])
+        _, ys = lax.scan(tick, init, jnp.arange(ticks))
+        # microbatch m leaves the last stage at tick m + S - 1
+        out_last = ys[n_stage - 1:]                      # (n_micro, mb, ...)
+        mask = (idx == n_stage - 1).astype(out_last.dtype)
+        return lax.psum(out_last * mask, axis)
+
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(),
+        check_rep=False)(stacked_params, x)
+
+
+def pipeline_train_step(stage_fn, loss_fn, stacked_params, x, labels, *,
+                        mesh, axis="pipe", lr=0.1):
+    """One jitted pipelined SGD step: forward pipeline, loss on the last
+    stage's outputs, backward through the reverse pipeline, update.
+    Returns (new_params, loss)."""
+    def objective(params):
+        out = pipeline_apply(stage_fn, params, x, mesh=mesh, axis=axis)
+        return loss_fn(out, labels)
+
+    loss, grads = jax.value_and_grad(objective)(stacked_params)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, stacked_params, grads)
+    return new_params, loss

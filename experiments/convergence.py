@@ -122,8 +122,8 @@ def run_imagenet():
             for s in (1, 400, 800, 1200, 1600)})
     # a clear, sustained descent below ln(1000)=6.9078 — NOT the dead-relu
     # plateau pinned there (the init-inflated curve[0] alone would pass a
-    # relative check)
-    assert curve[-1] < 6.85 and curve[-1] == min(
+    # relative check); best observed 6.8034, so gate just above it
+    assert curve[-1] < 6.81 and curve[-1] == min(
         curve[s] for s in (0, 399, 799, 1199, 1599)), \
         (curve[0], curve[-1])
 

@@ -116,3 +116,12 @@ silent = 1
                            index=np.arange(8, dtype=np.uint32)))
     assert np.isfinite(float(np.asarray(t._last_loss)))
     assert t.check_weight_consistency() == 0.0
+    # expert weights (and their optimizer state) are sharded over the
+    # expert axis AT REST — the memory benefit of expert parallelism
+    (moe_key,) = [k for k in t.params if "moe" in k]
+    from jax.sharding import PartitionSpec as P
+    assert t.params[moe_key]["wmat"].sharding.spec == P("expert", None, None)
+    m_state = t.opt_state[moe_key]["wmat"]
+    any_leaf = next(iter(m_state.values()))
+    assert any_leaf.sharding.spec == P("expert", None, None)
+    assert t.params[moe_key]["gate"].sharding.spec == P()
