@@ -32,7 +32,10 @@ class LearnTask:
         self.print_step = 100
         self.continue_training = 0
         self.save_period = 1
-        self.start_counter = 1
+        # reference default 0 (cxxnet_main.cpp:27): the pre-training
+        # snapshot is 0000.model and rounds 1..num_round then train —
+        # starting at 1 would silently train one round fewer
+        self.start_counter = 0
         self.name_model_in = "NULL"
         self.name_model_dir = "./"
         self.num_round = 10
@@ -116,14 +119,20 @@ class LearnTask:
         return net
 
     def _sync_latest_model(self) -> bool:
-        s = self.start_counter
         last = None
-        while True:
-            name = os.path.join(self.name_model_dir, f"{s:04d}.model")
-            if not os.path.exists(name):
+        # also accept snapshot dirs whose numbering starts one above
+        # start_counter (directories saved before the default moved to the
+        # reference's 0 have 0001.model as their first snapshot)
+        for s0 in (self.start_counter, self.start_counter + 1):
+            s = s0
+            while True:
+                name = os.path.join(self.name_model_dir, f"{s:04d}.model")
+                if not os.path.exists(name):
+                    break
+                last = name
+                s += 1
+            if last is not None:
                 break
-            last = name
-            s += 1
         if last is None:
             return False
         self.net = self._create_net()

@@ -116,8 +116,10 @@ def test_capi_train_predict(capi):
         return (got == y).mean()
 
     acc = accuracy()
-    if acc <= 0.8:  # marginal under parallel-reduction nondeterminism:
-        train_steps(80)  # keep training rather than flake
+    for _ in range(3):  # marginal under parallel-reduction
+        if acc > 0.8:   # nondeterminism: keep training rather than flake
+            break
+        train_steps(80)
         acc = accuracy()
     assert acc > 0.8, acc
     capi.CXNNetFree(net)
