@@ -35,6 +35,12 @@ class DataBatch:
     # number of trailing instances that are wrap-around padding; they are
     # trained on (they're real wrapped instances) but excluded from eval
     num_batch_padd: int = 0
+    # number of trailing instances that are *replica* padding of a short
+    # tail batch (round_batch=0): masked out of training losses AND eval.
+    # Always <= num_batch_padd.  The reference instead re-plumbs node
+    # shapes (AdjustBatchSize, neural_net-inl.hpp:266-277); padding with a
+    # loss mask trains the same real instances without shape polymorphism.
+    tail_mask_padd: int = 0
     extra_data: List[np.ndarray] = dataclasses.field(default_factory=list)
 
     @property

@@ -1,11 +1,11 @@
 """MNIST idx-ubyte iterator.
 
 Reference: ``src/io/iter_mnist-inl.hpp`` — reads the gzip idx files, scales
-pixels by 1/256, optional in-memory shuffle, emits fixed-size batches
-(tail instances beyond the last full batch are dropped, like the reference's
-``loc_ + batch_size <= ndata`` loop; set ``round_batch = 1`` to instead wrap
-the final batch and report ``num_batch_padd``, which TPU static shapes
-prefer for eval).
+pixels by 1/256, optional in-memory shuffle, emits fixed-size batches.
+The tail beyond the last full batch is replica-padded and loss-masked
+(``tail_mask_padd``) so every instance still trains; ``round_batch = 1``
+instead wraps real instances from the epoch start and reports
+``num_batch_padd`` (reference batch-adapter parity).
 """
 
 from __future__ import annotations
@@ -101,13 +101,24 @@ class MNISTIterator(IIterator):
             return DataBatch(data=self._view(idx),
                              label=self.labels[idx].reshape(bs, 1),
                              index=self.inst[idx])
-        if self.round_batch and self.loc < n:
+        if self.loc < n:
             remain = n - self.loc
-            idx = np.concatenate([np.arange(self.loc, n),
-                                  np.arange(0, bs - remain)])
+            if self.round_batch:
+                # wrap with the epoch's first instances (real data,
+                # eval-excluded but trained, reference parity)
+                idx = np.concatenate([np.arange(self.loc, n),
+                                      np.arange(0, bs - remain)])
+                mask_padd = 0
+            else:
+                # pad with replicas of the last instance, masked out of
+                # training (see io/iter_proc.py pad+mask rationale)
+                idx = np.concatenate([np.arange(self.loc, n),
+                                      np.full(bs - remain, n - 1)])
+                mask_padd = bs - remain
             self.loc = n
             return DataBatch(data=self._view(idx),
                              label=self.labels[idx].reshape(bs, 1),
                              index=self.inst[idx],
-                             num_batch_padd=bs - remain)
+                             num_batch_padd=bs - remain,
+                             tail_mask_padd=mask_padd)
         return None

@@ -25,10 +25,12 @@ _AUG_RAND_MAGIC = 111
 class BatchAdaptIterator(IIterator):
     """Packs DataInst into DataBatch (iter_batch_proc-inl.hpp:16-133).
 
-    ``round_batch = 1`` wraps the epoch boundary and records
-    ``num_batch_padd``; otherwise the tail partial batch is dropped.
-    ``test_skipread = 1`` returns the same batch without reading (I/O
-    isolation benchmark mode, :72-74).
+    ``round_batch = 1`` wraps the epoch boundary with real instances from
+    the epoch start and records ``num_batch_padd``; otherwise the tail
+    partial batch is replica-padded and loss-masked (``tail_mask_padd``)
+    so every real instance still trains (the reference's AdjustBatchSize
+    semantics without shape polymorphism).  ``test_skipread = 1`` returns
+    the same batch without reading (I/O isolation benchmark mode, :72-74).
     """
 
     def __init__(self, base: IIterator):
@@ -71,13 +73,14 @@ class BatchAdaptIterator(IIterator):
             out.append(inst)
         return out
 
-    def _pack(self, insts: List[DataInst], padd: int) -> DataBatch:
+    def _pack(self, insts: List[DataInst], padd: int,
+              mask_padd: int = 0) -> DataBatch:
         data = np.stack([i.data for i in insts]).astype(np.float32)
         label = np.stack([np.atleast_1d(i.label)[:self.label_width]
                           for i in insts]).astype(np.float32)
         index = np.array([i.index for i in insts], np.uint32)
         return DataBatch(data=data, label=label, index=index,
-                         num_batch_padd=padd)
+                         num_batch_padd=padd, tail_mask_padd=mask_padd)
 
     def next(self):
         if self.test_skipread and self._cached is not None:
@@ -99,7 +102,13 @@ class BatchAdaptIterator(IIterator):
             b = self._pack(insts + wrap, need)
             self._epoch_done = True
         else:
-            return None
+            # short tail: pad with replicas of the last instance and mask
+            # them out of training/eval, so every real instance still
+            # trains (the reference's AdjustBatchSize trains the tail by
+            # re-plumbing shapes, neural_net-inl.hpp:266-277; a TPU step
+            # is shape-static, so pad + loss-mask instead)
+            need = self.batch_size - len(insts)
+            b = self._pack(insts + [insts[-1]] * need, need, mask_padd=need)
         if self.test_skipread:
             self._cached = b
         return b

@@ -82,8 +82,11 @@ class NativeImageBinIterator(IIterator):
         self._cfg = []
         self._h: Optional[int] = None
         self._lib = None
+        self._round_batch = 0
 
     def set_param(self, name: str, val: str) -> None:
+        if name == "round_batch":
+            self._round_batch = int(val)
         self._cfg.append((name, val))
 
     def init(self) -> None:
@@ -120,9 +123,14 @@ class NativeImageBinIterator(IIterator):
             if err:
                 raise RuntimeError(f"native iterator: {err.decode()}")
             return None
+        # without round_batch, trailing padding is replica padding of the
+        # tail (C++ side pads with the last instance) — mask it out of
+        # training; round_batch wrap rows are real data and train unmasked
         return DataBatch(data=data, label=label,
                          index=index.astype(np.uint32),
-                         num_batch_padd=int(padd.value))
+                         num_batch_padd=int(padd.value),
+                         tail_mask_padd=0 if self._round_batch
+                         else int(padd.value))
 
     def close(self) -> None:
         if getattr(self, "_h", None) and self._lib is not None:

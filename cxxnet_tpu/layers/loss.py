@@ -58,6 +58,10 @@ class LossLayerBase(Layer):
         if ctx.labels is not None and ctx.train:
             y = ctx.labels.get(self.target)
             per_inst = self._per_instance_loss(x2d, out2d, y)
+            if ctx.labels.mask is not None:
+                # tail-batch replica padding contributes zero loss (and
+                # therefore zero gradient); see DataBatch.tail_mask_padd
+                per_inst = per_inst * ctx.labels.mask.astype(per_inst.dtype)
             # loss_scale = grad_scale / (batch_size * update_period); the sum
             # over instances then yields exactly the reference per-instance
             # gradient scaling (loss_layer_base-inl.hpp:61-62).

@@ -78,9 +78,22 @@ class BatchNormLayer(Layer):
         bshape = [1, 1, 1, 1]
         bshape[ax] = x.shape[ax]
         xf = x.astype(jnp.float32)
+        mask = ctx.labels.mask if (ctx.train and ctx.labels is not None) \
+            else None
         if ctx.train or not self.moving_average:
-            mean = xf.mean(reduce_axes)
-            var = jnp.square(xf - mean.reshape(bshape)).mean(reduce_axes)
+            if mask is not None:
+                # tail-batch replica padding is excluded from the batch
+                # statistics (the reference computes stats over the
+                # re-plumbed real batch only, AdjustBatchSize)
+                m4 = mask.astype(jnp.float32).reshape(-1, 1, 1, 1)
+                denom = jnp.maximum(
+                    m4.sum() * (xf.size / xf.shape[0] / xf.shape[ax]), 1.0)
+                mean = (xf * m4).sum(reduce_axes) / denom
+                var = (jnp.square(xf - mean.reshape(bshape)) * m4
+                       ).sum(reduce_axes) / denom
+            else:
+                mean = xf.mean(reduce_axes)
+                var = jnp.square(xf - mean.reshape(bshape)).mean(reduce_axes)
         else:
             mean = buffers["moving_mean"]
             var = buffers["moving_var"]

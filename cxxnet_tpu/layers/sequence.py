@@ -292,5 +292,9 @@ class SoftmaxSeqLayer(LossLayerBase):
             logp = jax.nn.log_softmax(x[:, 0].astype(jnp.float32), axis=-1)
             tok = jnp.take_along_axis(logp, y[:, :, None], axis=2)[:, :, 0]
             per_inst = -tok.mean(axis=1)  # mean per-token nats, per instance
+            if ctx.labels.mask is not None:
+                # tail-batch replica padding is masked out, same contract
+                # as LossLayerBase (DataBatch.tail_mask_padd)
+                per_inst = per_inst * ctx.labels.mask.astype(per_inst.dtype)
             ctx.losses.append(per_inst.sum() * (self.grad_scale * ctx.loss_scale))
         return [out], buffers

@@ -302,9 +302,16 @@ class LearnTask:
                 group, pending = pending, []
                 if self.test_io == 0:
                     # extra-data inputs aren't threaded through the scan
-                    # path; fall back to per-batch dispatch for them
-                    if len(group) > 1 and not any(b.extra_data
-                                                  for b in group):
+                    # path; fall back to per-batch dispatch for them.  A
+                    # short final batch (round_batch=0) can't be stacked
+                    # with full ones — shapes must be uniform to group
+                    uniform = all(
+                        b.data.shape == group[0].data.shape
+                        and b.label.shape == group[0].label.shape
+                        and b.tail_mask_padd == 0
+                        for b in group)
+                    if len(group) > 1 and uniform and not any(
+                            b.extra_data for b in group):
                         self._update_group(group)
                     else:
                         for b in group:

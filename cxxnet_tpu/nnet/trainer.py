@@ -62,6 +62,10 @@ class NetTrainer:
         self.dtype = jnp.float32
         self.mesh_spec: Optional[meshlib.MeshSpec] = None
         self.fullc_gather = 0
+        # pipeline parallelism (mesh = pipe:K): microbatches per step;
+        # 0 = auto (2 * pipe size, the usual bubble/efficiency trade)
+        self.pipe_microbatch = 0
+        self._pipe_partition = None
         self.shard_opt_state = 0
         self.silent = 0
         self.print_step = 100
@@ -95,6 +99,8 @@ class NetTrainer:
             self.mesh_spec = meshlib.MeshSpec.parse(val)
         elif name == "fullc_gather":
             self.fullc_gather = int(val)
+        elif name == "pipe_microbatch":
+            self.pipe_microbatch = int(val)
         elif name == "shard_opt_state" or name == "update_on_server":
             # update_on_server=1 (server-side optimizer states) maps to
             # ZeRO-style optimizer-state sharding over the data axis
@@ -269,11 +275,12 @@ class NetTrainer:
 
     # ----------------------------------------------------------- step build
     def _forward(self, params, buffers, data, label_vec, extras, *, train,
-                 rng, epoch):
+                 rng, epoch, mask=None):
         fields = {name: label_vec[:, a:b]
                   for name, a, b in self._label_fields} if label_vec is not None else {}
         ctx = ForwardContext(train=train, rng=rng,
-                             labels=LabelInfo(fields=fields) if fields else None,
+                             labels=LabelInfo(fields=fields, mask=mask)
+                             if fields else None,
                              epoch=epoch, loss_scale=self.loss_scale,
                              mesh=self.mesh if self.mesh.size > 1 else None)
         inputs = {0: data}
@@ -282,12 +289,90 @@ class NetTrainer:
         nodes, new_buffers = self.net.forward(params, buffers, inputs, ctx)
         return nodes, new_buffers, ctx
 
+    @property
+    def _pipelined(self) -> bool:
+        return "pipe" in self.mesh.axis_names and self.mesh.shape["pipe"] > 1
+
+    def _pipe_setup(self):
+        """Partition the graph once per trainer (static)."""
+        if self._pipe_partition is None:
+            from . import pipeline_net
+            n_stage = self.mesh.shape["pipe"]
+            stages, body_end = pipeline_net.partition_network(
+                self.net, n_stage)
+            if not self.silent:
+                desc = ", ".join(
+                    "+".join(self.net.connections[j].layer.type_names[0]
+                             for j in range(s0, s1))
+                    for s0, s1 in stages)
+                print(f"pipeline: {n_stage} stages [{desc}]", flush=True)
+            self._pipe_partition = (stages, body_end)
+        return self._pipe_partition
+
+    def _pipeline_forward(self, params, data, label_vec, *, train, rng,
+                          epoch, mask=None):
+        """Forward through the pipelined body + the post-pipeline loss
+        tail.  Returns (node env over tail nodes, ctx)."""
+        from ..parallel.pipeline import pipeline_apply_hetero
+        from . import pipeline_net
+        stages, body_end = self._pipe_setup()
+        stage_fns = pipeline_net.make_stage_fns(
+            self.net, stages, body_end, train=train, epoch=epoch,
+            loss_scale=self.loss_scale, rng=rng)
+        b = data.shape[0]
+        n_micro = self.pipe_microbatch or 2 * self.mesh.shape["pipe"]
+        assert b % n_micro == 0, (
+            f"pipeline: batch {b} not divisible by pipe_microbatch "
+            f"{n_micro}")
+        x = data.astype(self.dtype).reshape(n_micro, b // n_micro,
+                                            *data.shape[1:])
+        out = pipeline_apply_hetero(
+            stage_fns, params, x, mesh=self.mesh,
+            data_spec=self.batch_shard.spec)
+        out_node = pipeline_net._boundary_node(self.net, body_end, body_end)
+        out_flat = out.reshape(b, *out.shape[2:])
+        # loss tail (self-loop loss layers) outside the pipeline
+        fields = {name: label_vec[:, a:b_]
+                  for name, a, b_ in self._label_fields} \
+            if label_vec is not None else {}
+        ctx = ForwardContext(train=train, rng=rng,
+                             labels=LabelInfo(fields=fields, mask=mask)
+                             if fields else None,
+                             epoch=epoch, loss_scale=self.loss_scale)
+        nodes = {out_node: out_flat}
+        for conn in self.net.connections[body_end:]:
+            ins = [nodes[n] for n in conn.nindex_in]
+            p = params.get(conn.param_key, {})
+            outs, _ = conn.layer.forward(p, {}, ins, ctx)
+            for n, v in zip(conn.nindex_out, outs):
+                nodes[n] = v
+        return nodes, ctx
+
     def _loss_and_grads(self, params, buffers, data, label_vec, extras,
-                        epoch, rng, eval_ids):
+                        epoch, rng, eval_ids, mask=None):
+        if self._pipelined:
+            assert not extras, "pipeline: extra-data inputs unsupported"
+
+            def loss_fn(p):
+                nodes, ctx = self._pipeline_forward(
+                    p, data, label_vec, train=True, rng=rng, epoch=epoch,
+                    mask=mask)
+                assert ctx.losses, "network has no loss layer; cannot train"
+                total = sum(ctx.losses[1:], ctx.losses[0])
+                for nid in eval_ids:
+                    assert nid in nodes, (
+                        "pipeline: train-metric eval nodes must sit at or "
+                        "after the last stage boundary")
+                outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                        for nid in eval_ids}
+                return total, (buffers, outs, ctx.diagnostics)
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
         def loss_fn(p):
             nodes, new_buffers, ctx = self._forward(
                 p, buffers, data, label_vec, extras,
-                train=True, rng=rng, epoch=epoch)
+                train=True, rng=rng, epoch=epoch, mask=mask)
             assert ctx.losses, "network has no loss layer; cannot train"
             total = sum(ctx.losses[1:], ctx.losses[0])
             outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
@@ -316,13 +401,21 @@ class NetTrainer:
                 group, grads[pkey], opt_state[pkey], self.hypers[pkey])
         return new_p, new_s
 
-    def _build_train_step(self):
+    def _build_train_step(self, with_mask: bool = False):
+        """The jitted step.  ``with_mask`` statically selects the loss-mask
+        variant: almost every batch is unpadded, and threading an all-ones
+        mask through would make every masked code path (BatchNorm masked
+        statistics in particular) permanent hot-path work — so the masked
+        program is a separate compilation used only for the epoch's padded
+        tail batch."""
         accumulate = self.update_period > 1
         eval_ids = tuple(dict.fromkeys(self.eval_node_ids))
 
-        def loss_and_grads(params, buffers, data, label_vec, extras, epoch, rng):
+        def loss_and_grads(params, buffers, data, label_vec, extras, epoch,
+                           rng, mask):
             return self._loss_and_grads(params, buffers, data, label_vec,
-                                        extras, epoch, rng, eval_ids)
+                                        extras, epoch, rng, eval_ids,
+                                        mask=mask)
 
         def apply_update(operand, epoch):
             params, opt_state, grads = operand
@@ -330,11 +423,14 @@ class NetTrainer:
             zeroed = jax.tree.map(jnp.zeros_like, grads)
             return new_p, new_s, zeroed
 
+        mask_shard = (self.batch_shard,) if with_mask else ()
         if accumulate:
             def step(params, opt_state, buffers, grad_acc, data, label_vec,
-                     extras, epoch, rng, do_update):
+                     extras, epoch, rng, do_update, *maskarg):
+                mask = maskarg[0] if with_mask else None
                 (loss, (new_buffers, outs, diags)), grads = loss_and_grads(
-                    params, buffers, data, label_vec, extras, epoch, rng)
+                    params, buffers, data, label_vec, extras, epoch, rng,
+                    mask)
                 grads = jax.tree.map(jnp.add, grad_acc, grads)
                 params, opt_state, grads = jax.lax.cond(
                     do_update, lambda op: apply_update(op, epoch),
@@ -345,7 +441,8 @@ class NetTrainer:
             shardings_in = (self.param_shardings, self.opt_shardings,
                             self.buffer_shardings, self.param_shardings,
                             self.batch_shard, self.batch_shard,
-                            self.batch_shard, self.repl, self.repl, self.repl)
+                            self.batch_shard, self.repl, self.repl,
+                            self.repl) + mask_shard
             shardings_out = (self.param_shardings, self.opt_shardings,
                              self.buffer_shardings, self.param_shardings,
                              self.repl, self.repl, self.repl)
@@ -354,9 +451,10 @@ class NetTrainer:
                            donate_argnums=(0, 1, 2, 3))
 
         def step(params, opt_state, buffers, data, label_vec,
-                 extras, epoch, rng):
+                 extras, epoch, rng, *maskarg):
+            mask = maskarg[0] if with_mask else None
             (loss, (new_buffers, outs, diags)), grads = loss_and_grads(
-                params, buffers, data, label_vec, extras, epoch, rng)
+                params, buffers, data, label_vec, extras, epoch, rng, mask)
             params, opt_state, _ = apply_update(
                 (params, opt_state, grads), epoch)
             return params, opt_state, new_buffers, loss, outs, diags
@@ -364,7 +462,7 @@ class NetTrainer:
         shardings_in = (self.param_shardings, self.opt_shardings,
                         self.buffer_shardings,
                         self.batch_shard, self.batch_shard,
-                        self.batch_shard, self.repl, self.repl)
+                        self.batch_shard, self.repl, self.repl) + mask_shard
         shardings_out = (self.param_shardings, self.opt_shardings,
                          self.buffer_shardings,
                          self.repl, self.repl, self.repl)
@@ -518,30 +616,56 @@ class NetTrainer:
         data = self._device_batch(batch.data)
         label_vec = self._device_batch(batch.label, jnp.float32)
         extras = tuple(self._device_batch(e) for e in batch.extra_data)
+        # tail-batch padding: real instances train, padded replicas are
+        # masked out of every loss term (the reference instead re-plumbs
+        # node shapes per tail batch, AdjustBatchSize
+        # neural_net-inl.hpp:266-277 — shape-polymorphic steps would
+        # recompile on TPU, so pad + mask is the equivalent).  round_batch
+        # wrap instances (num_batch_padd without tail_mask_padd) are real
+        # data and train unmasked, as in the reference.
+        n_padd = int(getattr(batch, "tail_mask_padd", 0))
+        if n_padd:
+            # masked-step variant, compiled lazily (once per trainer): only
+            # the epoch's padded tail batch takes this path, so the common
+            # step never carries mask operands or masked-statistics code
+            host_mask = np.ones((batch.data.shape[0],), np.float32)
+            host_mask[batch.data.shape[0] - n_padd:] = 0.0
+            maskarg = (self._device_batch(host_mask),)
+            if getattr(self, "_train_step_masked", None) is None:
+                self._train_step_masked = self._build_train_step(
+                    with_mask=True)
+            step_fn = self._train_step_masked
+        else:
+            maskarg = ()
+            step_fn = self._train_step
         if self.update_period > 1:
             if getattr(self, "_grad_acc", None) is None:
                 self._grad_acc = self._grad_acc_init()
             (self.params, self.opt_state, self.buffers, self._grad_acc,
-             loss, outs, diags) = self._train_step(
+             loss, outs, diags) = step_fn(
                 self.params, self.opt_state, self.buffers, self._grad_acc,
                 data, label_vec, extras,
-                jnp.int32(epoch), rng, jnp.bool_(do_update))
+                jnp.int32(epoch), rng, jnp.bool_(do_update), *maskarg)
         else:
             (self.params, self.opt_state, self.buffers,
-             loss, outs, diags) = self._train_step(
+             loss, outs, diags) = step_fn(
                 self.params, self.opt_state, self.buffers,
-                data, label_vec, extras, jnp.int32(epoch), rng)
+                data, label_vec, extras, jnp.int32(epoch), rng, *maskarg)
         self._last_loss = loss
         self._last_outs = outs
         self._last_diags = diags
         if self.eval_train and self.train_metric.evals:
-            self.accumulate_train_metric(outs, batch.label)
+            self.accumulate_train_metric(outs, batch.label, n_padd=n_padd)
 
-    def accumulate_train_metric(self, outs, label) -> None:
+    def accumulate_train_metric(self, outs, label, n_padd: int = 0) -> None:
         """Add one batch's eval-node outputs to the train metric (shared by
-        the per-batch and grouped multi-step paths)."""
-        preds = [np.asarray(outs[nid]) for nid in self.eval_node_ids]
-        labels = {name: label[:, a:b] for name, a, b in self._label_fields}
+        the per-batch and grouped multi-step paths).  Padded tail instances
+        are excluded, matching the reference's num_batch_padd handling in
+        eval (nnet_impl-inl.hpp:237-240)."""
+        n_valid = label.shape[0] - n_padd
+        preds = [np.asarray(outs[nid])[:n_valid] for nid in self.eval_node_ids]
+        labels = {name: label[:n_valid, a:b]
+                  for name, a, b in self._label_fields}
         self.train_metric.add_eval(preds, labels)
 
     @property
@@ -606,16 +730,47 @@ class NetTrainer:
                 return conn.param_key
         raise KeyError(f"unknown layer name {layer_name!r}")
 
+    @staticmethod
+    def _walk_tag(group, tag: str, layer_name: str):
+        """Resolve a possibly-nested tag ("wmat", or "master:wmat" for a
+        pairtest layer's nested {master:{...}, slave:{...}} groups).
+        Returns (leaf_dict, leaf_tag)."""
+        parts = tag.split(":")
+        cur = group
+        for p in parts[:-1]:
+            if not isinstance(cur.get(p), dict):
+                raise KeyError(
+                    f"layer {layer_name!r} has no nested group {p!r}; "
+                    f"available: {sorted(cur)}")
+            cur = cur[p]
+        leaf = cur.get(parts[-1])
+        if isinstance(leaf, dict):
+            raise KeyError(
+                f"layer {layer_name!r} tag {tag!r} is a nested group "
+                f"(sub-tags {sorted(leaf)}); address a leaf like "
+                f"{tag}:{sorted(leaf)[0]}")
+        if leaf is None:
+            raise KeyError(
+                f"layer {layer_name!r} has no tag {tag!r}; "
+                f"available: {sorted(cur)}")
+        return cur, parts[-1]
+
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
-        return np.asarray(self.params[self._resolve_param_key(layer_name)][tag])
+        group = self.params[self._resolve_param_key(layer_name)]
+        leaf_dict, leaf_tag = self._walk_tag(group, tag, layer_name)
+        return np.asarray(leaf_dict[leaf_tag])
 
     def set_weight(self, value: np.ndarray, layer_name: str, tag: str) -> None:
         pkey = self._resolve_param_key(layer_name)
-        old = self.params[pkey][tag]
+        leaf_dict, leaf_tag = self._walk_tag(self.params[pkey], tag,
+                                             layer_name)
+        old = leaf_dict[leaf_tag]
         assert tuple(old.shape) == tuple(value.shape), \
             f"set_weight: shape mismatch {old.shape} vs {value.shape}"
-        self.params[pkey][tag] = jax.device_put(
-            jnp.asarray(value, old.dtype), self.param_shardings[pkey][tag])
+        shard_dict, _ = self._walk_tag(self.param_shardings[pkey], tag,
+                                       layer_name)
+        leaf_dict[leaf_tag] = jax.device_put(
+            jnp.asarray(value, old.dtype), shard_dict[leaf_tag])
         self._refresh_masters(pkey)
 
     def _refresh_masters(self, pkey: Optional[str] = None) -> None:

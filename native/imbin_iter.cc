@@ -500,8 +500,30 @@ class ImbinIterator {
     });
     if (ok && in_flight) ok = finish(std::move(in_flight));
 
-    // tail: wrap with head instances if round_batch (batch adapter parity)
-    if (ok && top > 0 && round_batch_) {
+    // tail: wrap with head instances if round_batch (batch adapter
+    // parity); otherwise pad with replicas of the last instance so the
+    // tail still trains (masked via num_batch_padd -> tail_mask_padd in
+    // the Python wrapper — see io/iter_proc.py pad+mask rationale)
+    if (ok && top > 0 && !round_batch_) {
+      cur->Wait();
+      Batch& b = cur->batch;
+      if (cur->failed.load()) {
+        run_err_ = "record decode failed (size/format mismatch)";
+      } else {
+        size_t need = batch_size_ - top;
+        for (size_t i = 0; i < need; ++i) {
+          std::memcpy(b.data.data() + (top + i) * inst_size(),
+                      b.data.data() + (top - 1) * inst_size(),
+                      inst_size() * sizeof(float));
+          std::memcpy(b.label.data() + (top + i) * label_width_,
+                      b.label.data() + (top - 1) * label_width_,
+                      label_width_ * sizeof(float));
+          b.index[top + i] = b.index[top - 1];
+        }
+        b.num_batch_padd = need;
+        if (!queue_.Push(std::move(b), gen)) return;
+      }
+    } else if (ok && top > 0 && round_batch_) {
       cur->Wait();
       Batch& b = cur->batch;
       if (cur->failed.load()) {

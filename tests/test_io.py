@@ -42,14 +42,17 @@ def make_insts(n, shape=(1, 4, 4)):
         rnd.randint(0, 3, n).astype(np.float32)
 
 
-def test_batch_adapter_drops_tail_by_default():
+def test_batch_adapter_pads_tail_by_default():
+    # the tail partial batch is padded + masked rather than dropped
+    # (reference AdjustBatchSize trains it; see tests/test_tail_batch.py)
     data, labels = make_insts(10)
     it = BatchAdaptIterator(ListInstIterator(data, labels))
     it.set_param("batch_size", "4")
     it.init()
     batches = list(it)
-    assert len(batches) == 2
+    assert len(batches) == 3
     assert all(b.batch_size == 4 for b in batches)
+    assert [b.tail_mask_padd for b in batches] == [0, 0, 2]
 
 
 def test_batch_adapter_round_batch_wraps_and_terminates():
@@ -149,7 +152,10 @@ def test_mnist_iterator(tmp_path):
     it.set_param("silent", "1")
     it.init()
     batches = list(it)
-    assert len(batches) == 2  # tail of 5 dropped
+    assert len(batches) == 3  # tail of 5 replica-padded + masked
+    assert batches[2].tail_mask_padd == 5
+    np.testing.assert_allclose(batches[2].data[5:],
+                               np.repeat(batches[2].data[4:5], 5, axis=0))
     np.testing.assert_allclose(
         batches[0].data.reshape(10, 25),
         imgs[:10].reshape(10, 25).astype(np.float32) / 256.0)

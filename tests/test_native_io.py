@@ -93,23 +93,29 @@ def collect_epoch(it):
 def test_native_basic_contents(tmp_path):
     it = make_native(tmp_path)
     batches = collect_epoch(it)
-    # 23 instances, batch 4, tail dropped without round_batch -> 5 batches
-    assert len(batches) == 5
+    # 23 instances, batch 4: tail replica-padded + masked -> 6 batches
+    assert len(batches) == 6
     seen = {}
+    for b in batches[:-1]:
+        assert b.num_batch_padd == 0
+        assert b.tail_mask_padd == 0
+    tail = batches[-1]
+    assert tail.num_batch_padd == 1 and tail.tail_mask_padd == 1
+    # the replica row copies the last real instance
+    np.testing.assert_array_equal(tail.data[3], tail.data[2])
     for b in batches:
         assert b.data.shape == (4, 3, 8, 8)
         assert b.label.shape == (4, 2)
-        assert b.num_batch_padd == 0
-        for j in range(4):
+        for j in range(4 - b.tail_mask_padd):
             i = int(b.index[j])
             seen[i] = (b.data[j], b.label[j])
-    assert len(seen) == 20
+    assert len(seen) == 23
     for i, (d, l) in seen.items():
         np.testing.assert_array_equal(d, np.full((3, 8, 8), i % 251,
                                                  np.float32))
         np.testing.assert_array_equal(l, [i, 2 * i])
     # second epoch identical
-    assert len(collect_epoch(it)) == 5
+    assert len(collect_epoch(it)) == 6
 
 
 def test_native_round_batch_and_f32(tmp_path):
@@ -370,16 +376,17 @@ def test_native_rejects_augmentation_keys(tmp_path):
 def test_native_error_cleared_on_restart(tmp_path):
     """A failed epoch's error must not poison a later epoch's normal end."""
     it = make_native(tmp_path, n=3)  # 3 insts < batch 4, round_batch off
-    # first epoch: dataset smaller than one batch and round_batch=0 -> just
-    # an empty epoch, no error; now force an error epoch via a dataset that
-    # trips round_batch wrap with too few instances
+    # first: force an error epoch via a dataset that trips round_batch
+    # wrap with too few instances
     (tmp_path / "b").mkdir()
     it2 = make_native(tmp_path / "b", n=1, extra="round_batch = 1")
     it2.before_first()
     with pytest.raises(RuntimeError, match="smaller than batch"):
         while it2.next() is not None:
             pass
-    # restart: same data still errors (dataset is still too small), but a
-    # fresh iterator over good data must end cleanly after an earlier error
+    # restart: a fresh iterator over good data must work cleanly after an
+    # earlier error — 3 insts pad to one masked batch, then a clean end
     it.before_first()
-    assert it.next() is None  # empty epoch, clean end, no stale error
+    b = it.next()
+    assert b is not None and b.tail_mask_padd == 1
+    assert it.next() is None  # clean end, no stale error

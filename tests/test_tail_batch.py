@@ -1,0 +1,132 @@
+"""Tail-batch training: pad + mask instead of drop.
+
+The reference trains the last partial batch of an epoch by re-plumbing node
+shapes (AdjustBatchSize, neural_net-inl.hpp:266-277).  Here the batch adapter
+pads the tail with replicas (DataBatch.tail_mask_padd) and the trainer masks
+them out of every loss term — all real instances train, no shape
+polymorphism, and the padding content cannot influence the update.
+"""
+
+import numpy as np
+
+from cxxnet_tpu.io.data import DataBatch, DataInst, IIterator
+from cxxnet_tpu.io.iter_proc import BatchAdaptIterator
+
+from test_trainer import MLP_CONF, make_trainer
+
+
+class _ListIter(IIterator):
+    def __init__(self, insts):
+        self.insts = insts
+        self.pos = 0
+
+    def before_first(self):
+        self.pos = 0
+
+    def next(self):
+        if self.pos >= len(self.insts):
+            return None
+        inst = self.insts[self.pos]
+        self.pos += 1
+        return inst
+
+
+def _insts(n, dim=4, seed=0):
+    rnd = np.random.RandomState(seed)
+    return [DataInst(label=np.array([i % 2], np.float32),
+                     data=rnd.rand(1, 1, dim).astype(np.float32),
+                     index=i) for i in range(n)]
+
+
+def test_batch_adapter_pads_tail():
+    it = BatchAdaptIterator(_ListIter(_insts(10)))
+    it.set_param("batch_size", "4")
+    it.set_param("round_batch", "0")
+    it.init()
+    it.before_first()
+    batches = list(iter(it))
+    assert len(batches) == 3, "tail must be padded, not dropped"
+    assert [b.tail_mask_padd for b in batches] == [0, 0, 2]
+    assert [b.num_batch_padd for b in batches] == [0, 0, 2]
+    # every real instance appears exactly once among unmasked rows
+    seen = [int(i) for b in batches
+            for i in b.index[:b.batch_size - b.tail_mask_padd]]
+    assert sorted(seen) == list(range(10))
+    # replicas copy the last real instance (shape stays uniform)
+    assert batches[2].data.shape == batches[0].data.shape
+    np.testing.assert_array_equal(batches[2].data[2], batches[2].data[1])
+
+
+def test_round_batch_unchanged():
+    it = BatchAdaptIterator(_ListIter(_insts(10)))
+    it.set_param("batch_size", "4")
+    it.set_param("round_batch", "1")
+    it.init()
+    it.before_first()
+    batches = list(iter(it))
+    assert len(batches) == 3
+    # wrap instances are real data: eval-excluded but NOT train-masked
+    assert [b.num_batch_padd for b in batches] == [0, 0, 2]
+    assert [b.tail_mask_padd for b in batches] == [0, 0, 0]
+
+
+def _step_params(trainer, batch):
+    trainer.update(batch)
+    return {k: {t: np.asarray(v) for t, v in g.items()}
+            for k, g in trainer.params.items()}
+
+
+def test_masked_padding_content_invariant():
+    """Two padded batches sharing the same real rows but different padding
+    content must produce identical parameter updates."""
+    rnd = np.random.RandomState(3)
+    real_x = rnd.rand(2, 1, 1, 8).astype(np.float32)
+    real_y = np.array([[0.0], [1.0]], np.float32)
+
+    def padded(pad_fill):
+        x = np.concatenate([real_x, pad_fill], axis=0)
+        y = np.concatenate([real_y, np.ones((2, 1), np.float32)], axis=0)
+        return DataBatch(data=x, label=y,
+                         index=np.arange(4, dtype=np.uint32),
+                         num_batch_padd=2, tail_mask_padd=2)
+
+    pa = padded(np.zeros((2, 1, 1, 8), np.float32))
+    pb = padded(rnd.rand(2, 1, 1, 8).astype(np.float32) * 50.0)
+
+    ta = make_trainer(MLP_CONF, extra=[("batch_size", "4"), ("seed", "7")])
+    tb = make_trainer(MLP_CONF, extra=[("batch_size", "4"), ("seed", "7")])
+    params_a = _step_params(ta, pa)
+    params_b = _step_params(tb, pb)
+    for k in params_a:
+        for tag in params_a[k]:
+            np.testing.assert_allclose(
+                params_a[k][tag], params_b[k][tag], rtol=0, atol=0,
+                err_msg=f"padding content leaked into update of {k}/{tag}")
+
+
+def test_epoch_with_non_dividing_batch_trains_all():
+    """An epoch over N instances with batch_size not dividing N must train
+    on every instance: memorizing 6 one-hot-separable instances with
+    batch 4 drives train error to 0 (impossible if the tail 2 were
+    dropped every epoch)."""
+    insts = []
+    for i in range(6):
+        x = np.zeros((1, 1, 8), np.float32)
+        x[0, 0, i] = 1.0
+        insts.append(DataInst(label=np.array([i % 2], np.float32),
+                              data=x, index=i))
+    t = make_trainer(MLP_CONF, extra=[("batch_size", "4"), ("eta", "0.5")])
+    for _ in range(60):
+        it = BatchAdaptIterator(_ListIter(insts))
+        it.set_param("batch_size", "4")
+        it.init()
+        it.before_first()
+        for b in iter(it):
+            t.update(b)
+    # eval on the exact 6 instances (pad excluded from metric path)
+    it = BatchAdaptIterator(_ListIter(insts))
+    it.set_param("batch_size", "4")
+    it.init()
+    line = t.evaluate(iter(it), "memorize")
+    err = float(line.split("error:")[1])
+    assert err == 0.0, f"tail instances failed to train: {line}"
