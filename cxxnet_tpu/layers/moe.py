@@ -16,13 +16,28 @@ Config::
       moe_alpha = 0.01          # load-balance aux loss weight
 
 Forward (tokens t = batch*seq, model dim d, experts e, capacity c):
-  gate probs (t, e) -> top-1 expert + position-in-expert via cumsum;
-  dispatch  x_e = einsum('tec,td->ecd', D, x)      (all-to-all on e)
-  expert FFN x_e @ w1[e] -> gelu -> @ w2[e]        (batched per-expert MXU)
-  combine   y  = einsum('ecd,tec->td', y_e, D * p) (all-to-all back)
-Tokens beyond an expert's capacity are dropped (standard Switch behavior:
-their residual path carries them).  The Switch load-balancing aux loss
-alpha * E * sum_e f_e * P_e is appended to ctx.losses.
+  gate probs (t, e) -> top-1 expert + position-in-expert;
+  dispatch  x_e (e, c, d); expert FFN x_e @ w1[e] -> gelu -> @ w2[e];
+  combine   y = x + gate_p * FFN(x)  (dropped tokens: y = x — the residual
+  applies to EVERY token, so behavior is continuous at the capacity
+  boundary rather than flipping between gate_p*E(x) and x).
+
+Two dispatch implementations behind one contract (``moe_dispatch``):
+
+* ``dense`` — the one-hot (t, e, c) einsum pair.  O(t*e*c) mask FLOPs and
+  an e*c*t intermediate: exact, simple, and on an ``expert`` mesh axis
+  GSPMD turns the einsums into all-to-alls — kept as the small-scale
+  oracle and the expert-parallel path.
+* ``sorted`` (default off-mesh) — argsort tokens by expert, derive each
+  token's slot from its position past its expert's segment start, then
+  move data with two gathers (slot->token for dispatch, token->slot for
+  combine).  The only scatters are int32 index builds of size e*c and t.
+  No (t, e, c) tensor ever exists: memory O(e*c*d + t) and the mask
+  arithmetic drops from O(t*e*c) to O(t log t) for the sort.
+
+``auto`` picks dense on an expert mesh, sorted otherwise.  The Switch
+load-balancing aux loss alpha * E * sum_e f_e * P_e is appended to
+ctx.losses (tail-batch replica tokens are excluded via the loss mask).
 """
 
 from __future__ import annotations
@@ -52,6 +67,8 @@ class MoELayer(Layer):
         self.num_expert = 0
         self.capacity_factor = 1.25
         self.moe_alpha = 0.01
+        self.moe_dispatch = "auto"   # auto | dense | sorted
+        self.router_jitter = 0.0     # train-time multiplicative gate noise
 
     def set_param(self, name, val):
         if name == "num_expert":
@@ -60,6 +77,12 @@ class MoELayer(Layer):
             self.capacity_factor = float(val)
         elif name == "moe_alpha":
             self.moe_alpha = float(val)
+        elif name == "moe_dispatch":
+            assert val in ("auto", "dense", "sorted"), \
+                f"moe_dispatch must be auto|dense|sorted, got {val!r}"
+            self.moe_dispatch = val
+        elif name == "router_jitter":
+            self.router_jitter = float(val)
         else:
             super().set_param(name, val)
 
@@ -85,6 +108,68 @@ class MoELayer(Layer):
             "bias2": jnp.full((e, d), p.init_bias, dtype),
         }
 
+    # -- dispatch/combine implementations ---------------------------------
+    def _ffn(self, params, xe, eshard):
+        """Batched per-expert FFN on (e, c, d) slots."""
+        w1 = eshard(params["wmat"].astype(xe.dtype), P("expert", None, None))
+        w2 = eshard(params["wmat2"].astype(xe.dtype),
+                    P("expert", None, None))
+        b1 = eshard(params["bias"].astype(xe.dtype), P("expert", None))
+        b2 = eshard(params["bias2"].astype(xe.dtype), P("expert", None))
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, w1) + b1[:, None, :])
+        return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+    def _dense_path(self, params, x, expert, gate_p, c, eshard):
+        """One-hot (t, e, c) dispatch — exact oracle; on an expert mesh
+        the einsums become GSPMD all-to-alls."""
+        e = self.num_expert
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+        pos_tok = jnp.sum(pos, axis=-1)
+        keep = pos_tok < c
+        disp = onehot * keep[:, None]
+        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), c,
+                              dtype=jnp.float32)
+        dmat = (disp[:, :, None] * slot[:, None, :]).astype(x.dtype)
+        xe = eshard(jnp.einsum("tec,td->ecd", dmat, x),
+                    P("expert", None, None))
+        ye = eshard(self._ffn(params, xe, eshard), P("expert", None, None))
+        comb = dmat * gate_p.astype(x.dtype)[:, None, None]
+        return jnp.einsum("ecd,tec->td", ye, comb)
+
+    def _sorted_path(self, params, x, expert, gate_p, c, eshard):
+        """Sort-based dispatch: no (t, e, c) tensor.  A stable argsort by
+        expert gives each token's position past its expert's segment
+        start; data moves via two gathers (and their scatter-add
+        transposes in backward), with only int32 index builds scattered."""
+        e = self.num_expert
+        t, d = x.shape
+        ec = e * c
+        order = jnp.argsort(expert, stable=True)          # (t,)
+        sorted_e = expert[order]
+        seg_start = jnp.searchsorted(sorted_e, jnp.arange(e), side="left")
+        pos_sorted = jnp.arange(t) - seg_start[sorted_e]
+        keep_sorted = pos_sorted < c
+        dest = sorted_e * c + pos_sorted                  # slot per token
+        dest_ok = jnp.where(keep_sorted, dest, ec)        # ec = dropped
+        # which token fills each slot (empty slots stay at sentinel 0 and
+        # are zero-masked after the gather)
+        token_for_slot = jnp.zeros((ec,), jnp.int32).at[dest_ok].set(
+            order.astype(jnp.int32), mode="drop")
+        slot_filled = jnp.zeros((ec,), jnp.bool_).at[dest_ok].set(
+            True, mode="drop")
+        xe = jnp.where(slot_filled[:, None], x[token_for_slot],
+                       jnp.zeros((), x.dtype)).reshape(e, c, d)
+        ye = self._ffn(params, eshard(xe, P("expert", None, None)), eshard)
+        # combine: token -> its slot (or sentinel ec for dropped)
+        slot_of_token = jnp.full((t,), ec, jnp.int32).at[order].set(
+            dest_ok.astype(jnp.int32))
+        valid = slot_of_token < ec
+        gathered = ye.reshape(ec, d)[jnp.minimum(slot_of_token, ec - 1)]
+        return jnp.where(valid[:, None],
+                         gathered * gate_p.astype(x.dtype)[:, None],
+                         jnp.zeros((), x.dtype))
+
     def forward(self, params, buffers, inputs, ctx):
         self.check_n_inputs(inputs, 1)
         x4 = inputs[0]                       # (b, 1, s, d)
@@ -95,21 +180,15 @@ class MoELayer(Layer):
         x = x4.reshape(t, d)
 
         # top-1 routing in f32 (gate numerics should not depend on dtype)
-        logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)
+        xg = x.astype(jnp.float32)
+        if ctx.train and self.router_jitter > 0:
+            eps = self.router_jitter
+            xg = xg * jax.random.uniform(ctx.next_rng(), xg.shape,
+                                         jnp.float32, 1 - eps, 1 + eps)
+        logits = xg @ params["gate"].astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)          # (t, e)
         expert = jnp.argmax(probs, axis=-1)              # (t,)
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
-        gate_p = jnp.sum(probs * onehot, axis=-1)        # (t,)
-
-        # position of each token within its expert; beyond-capacity drops
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (t, e)
-        pos_tok = jnp.sum(pos, axis=-1)                    # (t,)
-        keep = pos_tok < c
-        disp = onehot * keep[:, None]                    # (t, e)
-        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), c,
-                              dtype=jnp.float32)              # (t, c)
-        dmat = disp[:, :, None] * slot[:, None, :]       # (t, e, c)
-        dmat = dmat.astype(x.dtype)
+        gate_p = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
 
         mesh = _expert_mesh(ctx)
 
@@ -119,32 +198,34 @@ class MoELayer(Layer):
             return jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh, spec))
 
-        # dispatch: (t, e, c) x (t, d) -> (e, c, d); sharding the e axis
-        # makes GSPMD emit the all-to-all over the expert mesh axis
-        xe = jnp.einsum("tec,td->ecd", dmat, x)
-        xe = eshard(xe, P("expert", None, None))
-        w1 = eshard(params["wmat"].astype(x.dtype), P("expert", None, None))
-        w2 = eshard(params["wmat2"].astype(x.dtype), P("expert", None, None))
-        b1 = eshard(params["bias"].astype(x.dtype), P("expert", None))
-        b2 = eshard(params["bias2"].astype(x.dtype), P("expert", None))
-        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, w1)
-                        + b1[:, None, :])
-        ye = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
-        ye = eshard(ye, P("expert", None, None))
-        # combine, weighted by the gate probability (straight-through on
-        # the routing, differentiable through the prob)
-        comb = dmat * gate_p.astype(x.dtype)[:, None, None]
-        y = jnp.einsum("ecd,tec->td", ye, comb)
-        # dropped tokens ride the residual
-        y = y + jnp.where(keep[:, None], jnp.zeros((), x.dtype), x)
+        dispatch = self.moe_dispatch
+        if dispatch == "auto":
+            # dense keeps the einsum structure GSPMD turns into expert
+            # all-to-alls; sorted is the scalable single-host/dp default
+            dispatch = "dense" if mesh is not None else "sorted"
+        path = self._dense_path if dispatch == "dense" else self._sorted_path
+        y = path(params, x, expert, gate_p, c, eshard)
+        # EVERY token keeps its residual: y = x + gate_p * E(x), dropped
+        # tokens y = x — continuous at the capacity boundary (round-2
+        # advisor finding: the old form flipped between gate_p*E(x) and x)
+        y = x + y
 
         if ctx.train and self.moe_alpha > 0:
             # Switch aux loss: E * sum_e (fraction routed)*(mean prob) —
             # already a batch statistic, so scale by loss_scale*b
             # (= 1/update_period): its weight must stay O(moe_alpha)
-            # regardless of sequence length
-            frac = jnp.mean(onehot, axis=0)
-            meanp = jnp.mean(probs, axis=0)
+            # regardless of sequence length.  Tail-batch replica tokens
+            # (loss mask 0) are excluded from both statistics.
+            lmask = ctx.labels.mask if ctx.labels is not None else None
+            onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+            if lmask is not None:
+                tm = jnp.repeat(lmask.astype(jnp.float32), s)  # (t,)
+                denom = jnp.maximum(tm.sum(), 1.0)
+                frac = (onehot * tm[:, None]).sum(axis=0) / denom
+                meanp = (probs * tm[:, None]).sum(axis=0) / denom
+            else:
+                frac = jnp.mean(onehot, axis=0)
+                meanp = jnp.mean(probs, axis=0)
             ctx.losses.append(
                 (self.moe_alpha * e * jnp.sum(frac * meanp)
                  ).astype(jnp.float32) * ctx.loss_scale * b)

@@ -1,0 +1,160 @@
+"""Pipeline-parallel execution of a netconfig graph.
+
+Partitions ``Network.connections`` into K contiguous stages at points where
+the live-activation frontier is a single node (pool/flatten boundaries in a
+conv net), balances stages by a FLOP estimate, and runs the body through
+:func:`cxxnet_tpu.parallel.pipeline.pipeline_apply_hetero` with microbatches
+drawn from the batch dim.  The trailing loss layers (self-loops, reference
+``loss/loss_layer_base-inl.hpp:36``) run outside the pipeline on the
+collected outputs, so ``ctx.losses``/label plumbing is unchanged.
+
+No reference counterpart — the reference's only scaling axis is data
+parallelism through mshadow-ps (SURVEY.md §2.8); ``mesh = pipe:K`` extends
+the same config surface to pipeline parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import jax.numpy as jnp
+
+from ..layers.base import ForwardContext
+from ..layers.conv import ConvolutionLayer
+from ..layers.fullc import FullConnectLayer
+
+
+def _conn_cost(net, ci: int) -> float:
+    """FLOP estimate for balancing (conv/fullc dominate; everything else
+    counts as its output size, a bandwidth proxy)."""
+    conn = net.connections[ci]
+    out_shape = net.node_shapes[conn.nindex_out[0]]
+    l = conn.layer
+    if isinstance(l, ConvolutionLayer):
+        n, co, oh, ow = out_shape
+        ci_ = net.node_shapes[conn.nindex_in[0]][1]
+        p = l.param
+        return (2.0 * n * co * oh * ow * (ci_ // p.num_group)
+                * p.kernel_height * p.kernel_width)
+    if isinstance(l, FullConnectLayer):
+        nin = net.node_shapes[conn.nindex_in[0]]
+        return 2.0 * nin[0] * nin[1] * nin[2] * nin[3] * l.param.num_hidden
+    return float(out_shape[0] * out_shape[1] * out_shape[2] * out_shape[3])
+
+
+def partition_network(net, n_stage: int) -> Tuple[List[Tuple[int, int]], int]:
+    """Split the graph body into ``n_stage`` contiguous connection ranges.
+
+    Returns ``(stages, body_end)`` where ``stages`` is a list of
+    ``[start, end)`` ranges over ``net.connections`` and connections from
+    ``body_end`` on (the trailing loss layers) run post-pipeline.  A cut
+    after connection i is legal only when exactly one produced node is
+    still live (consumed later) — the single activation that crosses the
+    stage boundary.
+    """
+    conns = net.connections
+    # body = everything before the first loss layer
+    body_end = len(conns)
+    for i, c in enumerate(conns):
+        if c.layer.is_loss:
+            body_end = i
+            break
+    assert body_end > 0, "pipeline: network has no non-loss body"
+    for c in conns[:body_end]:
+        nb = c.layer.init_buffers(
+            [net.node_shapes[n] for n in c.nindex_in])
+        assert not nb, (
+            f"pipeline: layer {c.layer.type_names[0]} keeps running "
+            "buffers (e.g. batch_norm moving stats); buffer updates don't "
+            "thread through the pipeline schedule yet")
+
+    # consumers per node over the body + the boundary into the loss tail
+    last_use = {}
+    for i, c in enumerate(conns):
+        for n in c.nindex_in:
+            last_use[n] = i
+    legal = []  # cut AFTER body connection i
+    for i in range(body_end - 1):
+        live = set()
+        for j in range(i + 1):
+            for n in conns[j].nindex_out:
+                if last_use.get(n, -1) > i:
+                    live.add(n)
+        # input nodes still needed later also cross the cut
+        for n in conns[0].nindex_in:
+            if last_use.get(n, -1) > i:
+                live.add(n)
+        if len(live) == 1:
+            legal.append(i)
+    # balance by prefix cost: pick the legal cut nearest each target
+    costs = [_conn_cost(net, i) for i in range(body_end)]
+    total = sum(costs)
+    prefix = []
+    acc = 0.0
+    for c in costs:
+        acc += c
+        prefix.append(acc)
+    cuts = []
+    avail = list(legal)
+    for k in range(1, n_stage):
+        target = total * k / n_stage
+        assert avail, (
+            f"pipeline: graph has too few single-node cut points for "
+            f"pipe:{n_stage} (found {len(legal)} legal cuts)")
+        best = min(avail, key=lambda i: abs(prefix[i] - target))
+        cuts.append(best)
+        avail = [i for i in avail if i > best]
+    bounds = [0] + [c + 1 for c in cuts] + [body_end]
+    stages = [(bounds[i], bounds[i + 1]) for i in range(n_stage)]
+    return stages, body_end
+
+
+def _boundary_node(net, end: int, body_end: int) -> int:
+    """The single live node crossing the cut after connection end-1."""
+    if end >= body_end:
+        return net.connections[body_end - 1].nindex_out[0]
+    last_use = {}
+    for i, c in enumerate(net.connections):
+        for n in c.nindex_in:
+            last_use[n] = i
+    live = [n for j in range(end) for n in net.connections[j].nindex_out
+            if last_use.get(n, -1) >= end]
+    live = list(dict.fromkeys(live))
+    assert len(live) == 1, f"cut after {end - 1} has frontier {live}"
+    return live[0]
+
+
+def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
+                   loss_scale: float, rng=None) -> List[Callable]:
+    """Build ``stage_fns[s](params, value, m)`` callables for
+    :func:`pipeline_apply_hetero`.
+
+    Each stage runs its connection range over a local node environment;
+    randomness is keyed per (microbatch, stage) so dropout etc. stay
+    deterministic under any pipe width.
+    """
+    import jax
+
+    n_stage = len(stages)
+    in_nodes = [net.connections[s0].nindex_in[0] for s0, _ in stages]
+    out_nodes = [_boundary_node(net, s1, body_end) for _, s1 in stages]
+
+    def mk(s, s0, s1):
+        def fn(params, value, m):
+            ctx = ForwardContext(
+                train=train,
+                rng=None if rng is None
+                else jax.random.fold_in(rng, m * n_stage + s),
+                epoch=epoch, loss_scale=loss_scale)
+            nodes = {in_nodes[s]: value}
+            for j in range(s0, s1):
+                conn = net.connections[j]
+                ins = [nodes[n] for n in conn.nindex_in]
+                p = params.get(conn.param_key, {})
+                outs, _ = conn.layer.forward(p, {}, ins, ctx)
+                for n, v in zip(conn.nindex_out, outs):
+                    nodes[n] = v
+            return nodes[out_nodes[s]]
+        return fn
+
+    return [mk(s, s0, s1) for s, (s0, s1) in enumerate(stages)]

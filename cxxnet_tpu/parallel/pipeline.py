@@ -89,6 +89,80 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jnp.ndarray,
         check_rep=False)(stacked_params, x)
 
 
+def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
+                          axis: str = "pipe",
+                          data_spec: P = P()) -> jnp.ndarray:
+    """GPipe schedule over *heterogeneous* stages (different activation
+    shapes and per-stage parameter structures) — the form a real layered
+    network needs (a conv stack's stage boundaries are pool/flatten shapes,
+    not one repeated block).
+
+    ``stage_fns[s](params, value, m)``: stage ``s`` maps its input-boundary
+    activation to its output-boundary activation for microbatch index ``m``
+    (for per-microbatch randomness).  ``params`` is passed whole and
+    replicated over ``axis``; each branch uses only its own stage's slices.
+    ``x``: (n_micro, mb, ...) microbatches.  Returns (n_micro, mb, ...) of
+    the LAST stage's outputs.
+
+    Mechanics: the scan carry holds one activation buffer per stage
+    boundary (a K-tuple, since shapes differ a single rotating buffer can't
+    serve).  Each tick, every device runs exactly its own stage via
+    ``lax.switch`` on the pipe index, writes boundary ``s``, and all
+    buffers rotate one hop with ``ppermute`` — microbatch ``m`` leaves
+    stage K-1 at tick ``m + K - 1``.  Autodiff runs the reverse pipeline
+    through the transposed ppermute, as in :func:`pipeline_apply`.
+    ``data_spec`` shards the per-microbatch batch dim over a "data" axis
+    for combined dp x pp meshes.
+    """
+    n_stage = mesh.shape[axis]
+    assert len(stage_fns) == n_stage, \
+        f"{len(stage_fns)} stages for a {axis}:{n_stage} mesh"
+    n_micro = x.shape[0]
+    ticks = n_micro + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def spmd(params, xs):
+        idx = lax.axis_index(axis)
+        # boundary shapes, derived on the *local* (possibly data-sharded)
+        # microbatch without running anything
+        bshapes = []
+        cur = jax.eval_shape(lambda a: a[0], xs)
+        for fn in stage_fns:
+            cur = jax.eval_shape(lambda p, v, fn=fn: fn(p, v, 0),
+                                 params, cur)
+            bshapes.append(cur)
+
+        def tick(bufs, t):
+            def mk_branch(s):
+                def branch(bufs):
+                    inp = xs[jnp.clip(t, 0, n_micro - 1)] if s == 0 \
+                        else bufs[s - 1]
+                    m = jnp.clip(t - s, 0, n_micro - 1)
+                    y = stage_fns[s](params, inp, m)
+                    return tuple(y if j == s else b
+                                 for j, b in enumerate(bufs))
+                return branch
+
+            bufs = lax.switch(idx, [mk_branch(s) for s in range(n_stage)],
+                              bufs)
+            y_last = bufs[n_stage - 1]
+            bufs = tuple(lax.ppermute(b, axis, perm) for b in bufs)
+            return bufs, y_last
+
+        init = tuple(jnp.zeros(s.shape, s.dtype) for s in bshapes)
+        _, ys = lax.scan(tick, init, jnp.arange(ticks))
+        out_last = ys[n_stage - 1:]              # (n_micro, mb, ...)
+        mask = (idx == n_stage - 1).astype(out_last.dtype)
+        return lax.psum(out_last * mask, axis)
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    xspec = P(None, *data_spec)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(pspec, xspec), out_specs=xspec,
+        check_rep=False)(params, x)
+
+
 def pipeline_train_step(stage_fn, loss_fn, stacked_params, x, labels, *,
                         mesh, axis="pipe", lr=0.1):
     """One jitted pipelined SGD step: forward pipeline, loss on the last

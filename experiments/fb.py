@@ -20,12 +20,14 @@ def main():
     from bench import conv_flops_per_image, PEAK_FLOPS
     t = _make_trainer(ALEXNET_NET, batch, "tpu",
                       extra=[("dtype", "bfloat16"), ("eval_train", "0")])
-    rnd = np.random.RandomState(0)
-    datas = jnp.asarray(
-        rnd.rand(scan_len, batch, 3, 227, 227).astype(np.float32)
-    ).astype(jnp.bfloat16)
-    labels = jnp.asarray(
-        rnd.randint(0, 1000, (scan_len, batch, 1)).astype(np.float32))
+    # generate on DEVICE: the tunneled host link (and one-core host rand)
+    # must not gate a chip-compute measurement
+    kd, kl = jax.random.split(jax.random.PRNGKey(0))
+    datas = jax.jit(lambda k: jax.random.uniform(
+        k, (scan_len, batch, 3, 227, 227), jnp.float32
+    ).astype(jnp.bfloat16))(kd)
+    labels = jax.jit(lambda k: jax.random.randint(
+        k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)
     t.start_round(1)
     c0 = time.perf_counter()
     np.asarray(t.update_many(datas, labels))

@@ -1,0 +1,180 @@
+"""Prototype: AlexNet conv-stack step time, NCHW vs CHWN activation layout.
+
+Round-2 found every Pallas kernel pays a relayout toll at the pallas_call
+boundary because XLA keeps conv activations batch-minor while a logical
+NCHW array enters Pallas W-minor.  Hypothesis: make the *logical* layout
+CHWN (batch in lanes) for the whole conv stack so Pallas blocks see
+(…, W, N) = (sublane, lane) with spatial/channel windows on freely-sliced
+major dims.  This script measures whether pure-XLA conv/pool/LRN work is
+layout-neutral before any framework integration.
+
+Usage: python experiments/layout_proto.py [batch]
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from experiments.mb_util import bench_op
+
+
+# ---- layout-parametric ops -------------------------------------------------
+# dims: NCHW or CHWN specs for lax.conv_general_dilated
+
+
+def conv(x, w, stride, pad, groups, layout, first=False):
+    lhs = "NCHW" if first else layout
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride),
+        padding=((pad, pad), (pad, pad)),
+        dimension_numbers=(lhs, "OIHW", layout),
+        feature_group_count=groups)
+
+
+def bias_add(x, b, layout):
+    shape = {"NCHW": (1, -1, 1, 1), "CHWN": (-1, 1, 1, 1),
+             "NHWC": (1, 1, 1, -1)}[layout]
+    return x + b.astype(x.dtype).reshape(shape)
+
+
+def max_pool(x, k, s, layout):
+    if layout == "NCHW":
+        dims, strides = (1, 1, k, k), (1, 1, s, s)
+    elif layout == "CHWN":
+        dims, strides = (1, k, k, 1), (1, s, s, 1)
+    else:  # NHWC
+        dims, strides = (1, k, k, 1), (1, s, s, 1)
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides,
+                             padding="VALID")
+
+
+def lrn(x, nsize, alpha, beta, knorm, layout):
+    ch_axis = {"NCHW": 1, "CHWN": 0, "NHWC": 3}[layout]
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    c = x.shape[ch_axis]
+    padw = [(0, 0)] * 4
+    padw[ch_axis] = (lo, hi)
+    sq = jnp.square(x)
+    xp = jnp.pad(sq, padw)
+    out = lax.slice_in_dim(xp, 0, c, axis=ch_axis)
+    for i in range(1, nsize):
+        out = out + lax.slice_in_dim(xp, i, i + c, axis=ch_axis)
+    norm = out * (alpha / nsize) + knorm
+    return x * lax.rsqrt(norm * lax.sqrt(norm))
+
+
+def alexnet_convstack(params, x, layout):
+    """conv1..pool5 exactly as the repo AlexNet config (227 input)."""
+    h = conv(x, params["w1"], 4, 0, 1, layout, first=True)
+    h = jax.nn.relu(bias_add(h, params["b1"], layout))
+    h = max_pool(h, 3, 2, layout)
+    h = lrn(h, 5, 0.001, 0.75, 1.0, layout)
+    h = conv(h, params["w2"], 1, 2, 2, layout)
+    h = jax.nn.relu(bias_add(h, params["b2"], layout))
+    h = max_pool(h, 3, 2, layout)
+    h = lrn(h, 5, 0.001, 0.75, 1.0, layout)
+    h = conv(h, params["w3"], 1, 1, 1, layout)
+    h = jax.nn.relu(bias_add(h, params["b3"], layout))
+    h = conv(h, params["w4"], 1, 1, 2, layout)
+    h = jax.nn.relu(bias_add(h, params["b4"], layout))
+    h = conv(h, params["w5"], 1, 1, 2, layout)
+    h = jax.nn.relu(bias_add(h, params["b5"], layout))
+    h = max_pool(h, 3, 2, layout)
+    if layout == "NCHW":
+        flat = h.reshape(h.shape[0], -1)
+    elif layout == "CHWN":  # (C, H, W, N) -> (N, CHW)
+        flat = h.transpose(3, 0, 1, 2).reshape(h.shape[3], -1)
+    else:  # NHWC: match NCHW flatten order for weight-shape parity
+        flat = h.transpose(0, 3, 1, 2).reshape(h.shape[0], -1)
+    return flat
+
+
+def full_net(params, x, y, layout):
+    flat = alexnet_convstack(params, x, layout)
+    h = jax.nn.relu(flat @ params["w6"] + params["b6"])
+    h = jax.nn.relu(h @ params["w7"] + params["b7"])
+    logits = (h @ params["w8"] + params["b8"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def make_params(key, dtype):
+    ks = jax.random.split(key, 16)
+    p = {}
+
+    def w(i, shape, scale=0.01):
+        return (scale * jax.random.normal(ks[i], shape)).astype(dtype)
+
+    p["w1"] = w(0, (96, 3, 11, 11))
+    p["b1"] = jnp.zeros((96,), dtype)
+    p["w2"] = w(1, (256, 48, 5, 5))
+    p["b2"] = jnp.ones((256,), dtype)
+    p["w3"] = w(2, (384, 256, 3, 3))
+    p["b3"] = jnp.zeros((384,), dtype)
+    p["w4"] = w(3, (384, 192, 3, 3))
+    p["b4"] = jnp.ones((384,), dtype)
+    p["w5"] = w(4, (256, 192, 3, 3))
+    p["b5"] = jnp.ones((256,), dtype)
+    p["w6"] = w(5, (9216, 4096))
+    p["b6"] = jnp.ones((4096,), dtype)
+    p["w7"] = w(6, (4096, 4096))
+    p["b7"] = jnp.ones((4096,), dtype)
+    p["w8"] = w(7, (4096, 1000))
+    p["b8"] = jnp.zeros((1000,), dtype)
+    return p
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    dtype = jnp.bfloat16
+    key = jax.random.PRNGKey(0)
+    params = make_params(key, dtype)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, 227, 227),
+                          jnp.float32).astype(dtype)
+    y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
+
+    def step(layout):
+        def f(params, x):
+            loss, grads = jax.value_and_grad(
+                lambda p: full_net(p, x, y, layout))(params)
+            # sgd-ish update so grads are consumed (matches real step shape)
+            new = jax.tree.map(lambda w, g: w - 0.01 * g.astype(w.dtype),
+                               params, grads)
+            return loss, new
+        return f
+
+    layouts = sys.argv[2].split(",") if len(sys.argv) > 2 \
+        else ["NCHW", "CHWN"]
+    for layout in layouts:
+        t = bench_op(step(layout), params, x, k1=2, k2=8, n=3)
+        print(f"{layout}: {t:.2f} ms/step  ({batch / t * 1e3:.0f} imgs/s)")
+
+    # forward-only comparison too (isolates conv fwd + pool + lrn)
+    for layout in layouts:
+        f = lambda p, xx: jnp.sum(  # noqa: E731
+            alexnet_convstack(p, xx, layout).astype(jnp.float32))
+        t = bench_op(f, params, x, k1=2, k2=8, n=3)
+        print(f"{layout} fwd-only: {t:.2f} ms")
+
+    # transpose probe: what does materializing a conv1-sized activation in
+    # another layout cost inside a step? (bounds the pallas boundary toll)
+    h1 = jax.random.normal(jax.random.PRNGKey(3), (batch, 96, 55, 55),
+                           jnp.float32).astype(jnp.bfloat16)
+    for perm, name in (((1, 2, 3, 0), "NCHW->CHWN"),
+                       ((0, 2, 3, 1), "NCHW->NHWC")):
+        f = lambda a: jnp.transpose(a, perm) * 2.0  # noqa: E731
+        t = bench_op(f, h1, k1=4, k2=24)
+        print(f"transpose {name} (96,55,55,b{batch}): {t:.3f} ms")
+    f = lambda a: a * 2.0  # noqa: E731
+    t = bench_op(f, h1, k1=4, k2=24)
+    print(f"copy same-layout baseline:            {t:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,8 @@ def bench_op(f, *args, k1=4, k2=24, n=4):
         def loop(*args):
             def body(i, acc):
                 s = 1.0 + 1e-6 * jnp.float32(i)
-                perturbed = tuple(a * s.astype(a.dtype) for a in args)
+                perturbed = jax.tree.map(
+                    lambda a: a * s.astype(a.dtype), tuple(args))
                 r = f(*perturbed)
                 leaves = jax.tree.leaves(r)
                 return acc + sum(jnp.sum(l).astype(jnp.float32)

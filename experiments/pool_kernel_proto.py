@@ -1,0 +1,198 @@
+"""Feasibility probe: fused relu+maxpool Pallas kernel in (C, H, W, N).
+
+The round-3 kernel plan puts batch in lanes (N=128 multiples) and spatial
+dims on freely-sliced major/sublane axes.  Blocks carry FULL (H, W) per
+(C-tile, N-tile) program — H*W*128 fits VMEM for every geometry in the
+zoo — so windows are all-static slices; the only Mosaic unknown is the
+STRIDED sublane access along W (x[..., j::s, :]).
+
+Times, on the AlexNet pool1 geometry (96, 55, 55, 1024):
+  1. XLA reduce_window relu+pool in CHWN        (the no-kernel baseline)
+  2. Pallas fused relu+pool fwd                 (strided sublane slices)
+  3. Pallas fused bwd: eq-mask all-ties unpool + relu mask
+  4. XLA select-and-scatter bwd in CHWN         (the SAS baseline)
+
+Usage: python experiments/pool_kernel_proto.py [C H W N k s]
+"""
+import functools
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:
+    pltpu = None
+
+from experiments.mb_util import bench_op
+
+
+def pool_out(i, k, s):
+    return min(i - k + s - 1, i - 1) // s + 1
+
+
+def _pick_cb(c, h, w, n_lanes, itemsize, budget=3 << 20):
+    cb = max(1, budget // max(h * w * n_lanes * itemsize, 1))
+    while c % cb:
+        cb -= 1
+    return cb
+
+
+# ---------------------------------------------------------------- kernels
+def _fwd_kernel(x_ref, o_ref, *, k, s, oh, ow):
+    a = jnp.maximum(x_ref[...], 0.0)          # (CB, H, W, NB)
+    rows = []
+    for r in range(oh):
+        acc = None
+        for i in range(k):
+            xr = a[:, s * r + i]              # (CB, W, NB)
+            for j in range(k):
+                v = xr[:, j:j + (ow - 1) * s + 1:s]   # strided sublane
+                acc = v if acc is None else jnp.maximum(acc, v)
+        rows.append(acc)
+    o_ref[...] = jnp.stack(rows, axis=1).astype(o_ref.dtype)
+
+
+def _bwd_kernel(x_ref, p_ref, dp_ref, dx_ref, *, k, s, oh, ow):
+    """eq-mask (all-ties) unpool + relu mask: one pass, full H in block."""
+    x = x_ref[...]
+    a = jnp.maximum(x, 0.0)
+    zero = jnp.zeros((), jnp.float32)
+    h = x.shape[1]
+    row_acc = [None] * h
+    for r in range(oh):
+        pv = p_ref[:, r]                      # (CB, OW, NB)
+        dv = dp_ref[:, r].astype(jnp.float32)
+        for i in range(k):
+            hrow = s * r + i
+            ar = a[:, hrow]
+            for j in range(k):
+                av = ar[:, j:j + (ow - 1) * s + 1:s]
+                contrib = jnp.where(av == pv, dv, zero)
+                # place back on the row at strided positions: build a
+                # full-width row via interleave (scatter-free): positions
+                # j + s*t for t in [0, ow)
+                wide = jnp.zeros(ar.shape, jnp.float32)
+                wide = wide.at[:, j:j + (ow - 1) * s + 1:s].add(contrib)
+                row_acc[hrow] = wide if row_acc[hrow] is None \
+                    else row_acc[hrow] + wide
+    rows = [jnp.zeros(a[:, 0].shape, jnp.float32) if rc is None else rc
+            for rc in row_acc]
+    dx = jnp.stack(rows, axis=1)
+    dx_ref[...] = jnp.where(x > 0.0, dx, zero).astype(dx_ref.dtype)
+
+
+def _call(kern, x, outs_shape, in_arrays, cb, nb, interpret):
+    c, h, w, n = x.shape
+    grid = (n // nb, c // cb)
+    vmem = pltpu.VMEM if (pltpu and not interpret) else None
+
+    def spec(shape4):
+        imap = lambda bn, bc: (bc, 0, 0, bn)  # noqa: E731
+        if vmem is None:
+            return pl.BlockSpec(shape4, imap)
+        return pl.BlockSpec(shape4, imap, memory_space=vmem)
+
+    in_specs = [spec((cb,) + a.shape[1:3] + (nb,)) for a in in_arrays]
+    out_spec = spec((cb,) + outs_shape[1:3] + (nb,))
+    return pl.pallas_call(
+        kern, grid=grid,
+        in_specs=in_specs, out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct(outs_shape, x.dtype),
+        interpret=interpret,
+    )(*in_arrays)
+
+
+def pallas_relu_pool_fwd(x, k, s, *, nb=128, interpret=False):
+    c, h, w, n = x.shape
+    oh, ow = pool_out(h, k, s), pool_out(w, k, s)
+    assert (oh - 1) * s + k == h and (ow - 1) * s + k == w, \
+        "prototype: exact-cover pools only"
+    cb = _pick_cb(c, h, w, nb, x.dtype.itemsize)
+    kern = functools.partial(_fwd_kernel, k=k, s=s, oh=oh, ow=ow)
+    return _call(kern, x, (c, oh, ow, n), [x], cb, nb, interpret)
+
+
+def pallas_relu_pool_bwd(x, p, dp, k, s, *, nb=128, interpret=False):
+    c, h, w, n = x.shape
+    oh, ow = p.shape[1], p.shape[2]
+    cb = _pick_cb(c, h, w, nb, 4)  # f32 accumulator dominates
+    kern = functools.partial(_bwd_kernel, k=k, s=s, oh=oh, ow=ow)
+    return _call(kern, x, x.shape, [x, p, dp], cb, nb, interpret)
+
+
+# ------------------------------------------------------------- baselines
+def xla_relu_pool_chwn(x, k, s):
+    return lax.reduce_window(jnp.maximum(x, 0.0), -jnp.inf, lax.max,
+                             (1, k, k, 1), (1, s, s, 1), "VALID")
+
+
+def main():
+    args = [int(a) for a in sys.argv[1:]] or [96, 55, 55, 1024]
+    c, h, w, n = args[:4]
+    k = args[4] if len(args) > 4 else 3
+    s = args[5] if len(args) > 5 else 2
+    on_tpu = jax.default_backend() == "tpu"
+    x = jax.random.normal(jax.random.PRNGKey(0), (c, h, w, n),
+                          jnp.float32).astype(jnp.bfloat16)
+
+    # correctness vs XLA first (small slice, interpret off-TPU)
+    xs = x[:8, :, :, :256]
+    want = xla_relu_pool_chwn(xs, k, s)
+    got = pallas_relu_pool_fwd(xs, k, s, interpret=not on_tpu)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=1e-2)
+    print("fwd correctness ok")
+
+    p = want
+    dp = jax.random.normal(jax.random.PRNGKey(1), p.shape,
+                           jnp.float32).astype(jnp.bfloat16)
+    got_dx = pallas_relu_pool_bwd(xs, p, dp, k, s, interpret=not on_tpu)
+    xf = np.maximum(np.asarray(xs, np.float32), 0.0)
+    pf = np.asarray(p, np.float32)
+    df = np.asarray(dp, np.float32)
+    oh, ow = pf.shape[1], pf.shape[2]
+    want_dx = np.zeros_like(xf)
+    for r in range(oh):
+        for cc in range(ow):
+            win = xf[:, s * r:s * r + k, s * cc:s * cc + k, :]
+            m = win == pf[:, r:r + 1, cc:cc + 1, :]
+            want_dx[:, s * r:s * r + k, s * cc:s * cc + k, :] += \
+                m * df[:, r:r + 1, cc:cc + 1, :]
+    want_dx *= (np.asarray(xs, np.float32) > 0)
+    np.testing.assert_allclose(np.asarray(got_dx, np.float32), want_dx,
+                               atol=5e-2)
+    print("bwd correctness ok (all-ties eq-mask + relu mask)")
+
+    if not on_tpu:
+        print("CPU: skipping timing")
+        return
+
+    t = bench_op(lambda a: xla_relu_pool_chwn(a, k, s), x)
+    print(f"XLA  relu+pool fwd CHWN: {t:.3f} ms")
+    t = bench_op(lambda a: pallas_relu_pool_fwd(a, k, s), x)
+    print(f"PALL relu+pool fwd CHWN: {t:.3f} ms")
+
+    p_full = xla_relu_pool_chwn(x, k, s)
+    dp_full = jax.random.normal(jax.random.PRNGKey(2), p_full.shape,
+                                jnp.float32).astype(jnp.bfloat16)
+
+    def sas_bwd(a, g):
+        _, vjp = jax.vjp(lambda v: xla_relu_pool_chwn(v, k, s), a)
+        return vjp(g)[0]
+
+    t = bench_op(sas_bwd, x, dp_full)
+    print(f"XLA  SAS bwd CHWN:       {t:.3f} ms")
+    t = bench_op(lambda a, pp, g: pallas_relu_pool_bwd(a, pp, g, k, s),
+                 x, p_full, dp_full)
+    print(f"PALL eq bwd CHWN:        {t:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

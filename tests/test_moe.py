@@ -32,21 +32,25 @@ def _reference_moe(x, params, c):
     for i in range(t):
         ei = expert[i]
         if counts[ei] >= c:
-            y[i] = x[i]  # dropped: identity
+            y[i] = x[i]  # dropped: pure residual
             continue
         counts[ei] += 1
         hdn = x[i] @ params["wmat"][ei] + params["bias"][ei]
         hdn = 0.5 * hdn * (1 + np.tanh(np.sqrt(2 / np.pi)
                                        * (hdn + 0.044715 * hdn ** 3)))
-        y[i] = (hdn @ params["wmat2"][ei] + params["bias2"][ei]) * probs[i, ei]
+        # every token keeps its residual (continuous at capacity boundary)
+        y[i] = x[i] + (hdn @ params["wmat2"][ei]
+                       + params["bias2"][ei]) * probs[i, ei]
     return y
 
 
 @pytest.mark.parametrize("cf", [10.0, 0.5])
-def test_moe_matches_reference_loop(cf):
+@pytest.mark.parametrize("dispatch", ["dense", "sorted"])
+def test_moe_matches_reference_loop(cf, dispatch):
     rnd = np.random.RandomState(0)
     b, s, d = 2, 8, 12
     layer = make_moe(e=4, h=16, cf=cf)
+    layer.set_param("moe_dispatch", dispatch)
     shapes = [(b, 1, s, d)]
     layer.infer_shapes(shapes)
     params = layer.init_params(jax.random.PRNGKey(1), shapes)
@@ -57,6 +61,55 @@ def test_moe_matches_reference_loop(cf):
     want = _reference_moe(x.reshape(-1, d), pnp,
                           layer._capacity(b * s)).reshape(b, 1, s, d)
     np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_sorted_matches_dense_grads():
+    """Differential: sorted dispatch must reproduce the dense one-hot
+    oracle exactly — outputs AND parameter gradients (routing, capacity
+    drops, and the two transposed gathers all agree)."""
+    rnd = np.random.RandomState(2)
+    b, s, d = 2, 16, 12
+    x = jnp.asarray(rnd.randn(b, 1, s, d), jnp.float32)
+
+    outs, grads = {}, {}
+    for dispatch in ("dense", "sorted"):
+        layer = make_moe(e=4, h=16, cf=0.6)  # tight capacity: drops occur
+        layer.set_param("moe_dispatch", dispatch)
+        shapes = [(b, 1, s, d)]
+        layer.infer_shapes(shapes)
+        params = layer.init_params(jax.random.PRNGKey(5), shapes)
+
+        def loss(p):
+            ctx = ForwardContext(train=True, loss_scale=1.0 / b)
+            (out,), _ = layer.forward(p, {}, [x], ctx)
+            return (out ** 2).sum() + ctx.losses[0], out
+
+        (l, out), g = jax.value_and_grad(loss, has_aux=True)(params)
+        outs[dispatch], grads[dispatch] = out, g
+
+    np.testing.assert_allclose(np.asarray(outs["sorted"]),
+                               np.asarray(outs["dense"]),
+                               rtol=1e-5, atol=1e-6)
+    for tag in grads["dense"]:
+        np.testing.assert_allclose(np.asarray(grads["sorted"][tag]),
+                                   np.asarray(grads["dense"][tag]),
+                                   rtol=2e-4, atol=1e-5, err_msg=tag)
+
+
+def test_moe_capacity_boundary_continuity():
+    """The ADVICE finding: a token's output must not jump discontinuously
+    when it crosses the capacity boundary — with the full residual, a
+    dropped token yields exactly x."""
+    layer = make_moe(e=2, h=8, cf=0.01)  # capacity 1: almost all dropped
+    b, s, d = 1, 8, 6
+    shapes = [(b, 1, s, d)]
+    layer.infer_shapes(shapes)
+    params = layer.init_params(jax.random.PRNGKey(3), shapes)
+    x = jnp.asarray(np.random.RandomState(4).randn(b, 1, s, d), jnp.float32)
+    (out,), _ = layer.forward(params, {}, [x], ForwardContext(train=False))
+    # at most 2 tokens (1 per expert) differ from the pure residual
+    diff = np.abs(np.asarray(out) - np.asarray(x)).reshape(s, d).max(axis=1)
+    assert (diff > 0).sum() <= 2
 
 
 def test_moe_aux_loss_and_grads():
