@@ -111,13 +111,26 @@ def bench_vgg():
     return ips, 3.0 * flops * ips / peak
 
 
+def transformer_flops_per_token(vocab: int, seq: int, dim: int,
+                                nlayer: int, ffn_mult: int = 4,
+                                causal: bool = True) -> float:
+    """Analytic forward model-FLOPs per token (2*MACs; causal attention
+    counts the triangle).  Standard convention: backward = 2x forward,
+    flash-attention recompute excluded (it inflates hardware FLOPs, not
+    model FLOPs)."""
+    proj = 4 * 2 * dim * dim                      # q,k,v,out
+    attn = 2 * 2 * seq * dim * (0.5 if causal else 1.0)
+    ffn = 2 * 2 * dim * ffn_mult * dim
+    return nlayer * (proj + attn + ffn) + 2 * dim * vocab
+
+
 def bench_transformer() -> float:
     """Long-context secondary metric: transformer LM step time (flash
     attention path), tokens/sec on one chip."""
     import jax.numpy as jnp
     from cxxnet_tpu.models import transformer
     from __graft_entry__ import _make_trainer
-    vocab, seq, batch, scan_len = 512, 4096, 2, 4
+    vocab, seq, batch, scan_len = 512, 4096, 8, 4
     t = _make_trainer(
         transformer(vocab=vocab, seq=seq, dim=512, nlayer=4, nhead=8),
         batch, "tpu", extra=[("dtype", "bfloat16"), ("updater", "adam"),
@@ -135,7 +148,14 @@ def bench_transformer() -> float:
     t0 = time.perf_counter()
     np.asarray(t.update_many(datas, labels))
     dt = (time.perf_counter() - t0) / scan_len
-    return batch * seq / dt
+    tok_s = batch * seq / dt
+    import jax
+    f_tok = transformer_flops_per_token(vocab, seq, 512, 4)
+    mfu = 3.0 * f_tok * tok_s / peak_flops(jax.devices()[0].device_kind)
+    print(f"bench: transformer MFU={mfu * 100:.1f}% "
+          f"(fwd {f_tok / 1e6:.1f} MFLOPs/token, b{batch})",
+          file=sys.stderr)
+    return tok_s
 
 
 def main() -> None:

@@ -62,6 +62,13 @@ def load_library() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32)]
     lib.CXNIONativeShape.argtypes = [ctypes.c_void_p,
                                      ctypes.POINTER(ctypes.c_longlong)]
+    lib.CXNIONativeNextBatchU8.restype = ctypes.c_int
+    lib.CXNIONativeNextBatchU8.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32)]
+    lib.CXNIONativeIsU8.restype = ctypes.c_int
+    lib.CXNIONativeIsU8.argtypes = [ctypes.c_void_p]
     lib.CXNIONativeLastError.restype = ctypes.c_char_p
     lib.CXNIONativeLastError.argtypes = [ctypes.c_void_p]
     lib.CXNIONativeFree.argtypes = [ctypes.c_void_p]
@@ -108,16 +115,30 @@ class NativeImageBinIterator(IIterator):
         self._lib.CXNIONativeBeforeFirst(self._h)
 
     def next(self) -> Optional[DataBatch]:
-        data = np.empty((self.batch_size, self.c, self.h, self.w), np.float32)
+        u8 = bool(self._lib.CXNIONativeIsU8(self._h))
         label = np.empty((self.batch_size, self.label_width), np.float32)
         index = np.empty((self.batch_size,), np.uint64)
         padd = ctypes.c_uint32(0)
-        got = self._lib.CXNIONativeNextBatch(
-            self._h,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            ctypes.byref(padd))
+        if u8:
+            # device-side-normalization path: raw u8 straight through
+            # (the trainer applies (x - mean_value) * scale on device)
+            data = np.empty((self.batch_size, self.c, self.h, self.w),
+                            np.uint8)
+            got = self._lib.CXNIONativeNextBatchU8(
+                self._h,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+                label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.byref(padd))
+        else:
+            data = np.empty((self.batch_size, self.c, self.h, self.w),
+                            np.float32)
+            got = self._lib.CXNIONativeNextBatch(
+                self._h,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.byref(padd))
         if not got:
             err = self._lib.CXNIONativeLastError(self._h)
             if err:

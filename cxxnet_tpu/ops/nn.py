@@ -314,11 +314,42 @@ def _max_pool_eq_bwd(ksize_y, ksize_x, stride, pad_y, pad_x, res, dy):
 _max_pool_eq.defvjp(_max_pool_eq_fwd, _max_pool_eq_bwd)
 
 
-def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
-               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+# pool layout: "chwn" transposes NCHW -> (C, H, W, N) around the
+# reduce_window / select-and-scatter pair.  Measured standalone on v5e
+# (AlexNet pool1, b1024): fwd 0.99ms vs 2.93 NCHW, SAS bwd 5.06 vs 8.47 —
+# XLA tiles the windowed ops far better with batch minor; whether the
+# transposes get absorbed in a full step is measured via fb.py.
+_POOL_LAYOUT = os.environ.get("CXXNET_POOL_LAYOUT", "nchw")
+
+
+def _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x):
     if _POOL_BWD in ("eq", "gather"):
         return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     return _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
+
+
+def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
+               pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    if _POOL_LAYOUT == "chwn" and _POOL_BWD == "sas":
+        xt = jnp.transpose(x, (1, 2, 3, 0))
+        # reuse the NCHW padding/window logic by viewing (C, H, W, N) as
+        # (N', C', H, W) with batch'=C and channel'=H: reduce_window only
+        # cares about which axes carry windows
+        yt = _pool_nchw_as_chwn(xt, ksize_y, ksize_x, stride, pad_y, pad_x)
+        return jnp.transpose(yt, (3, 0, 1, 2))
+    return _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x)
+
+
+def _pool_nchw_as_chwn(xt, ksize_y, ksize_x, stride, pad_y, pad_x):
+    """Max pool over dims (1, 2) of a (C, H, W, N) array with the
+    reference tail-window rule."""
+    pad_h, pad_w = _pool_padding(xt.shape[1], xt.shape[2], ksize_y,
+                                 ksize_x, stride, pad_y, pad_x)
+    return lax.reduce_window(
+        xt, -jnp.inf, lax.max,
+        window_dimensions=(1, ksize_y, ksize_x, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=((0, 0), pad_h, pad_w, (0, 0)))
 
 
 def sum_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,

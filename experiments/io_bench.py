@@ -111,14 +111,14 @@ def make_raw_dataset(work, n=2048, shape=(3, 227, 227)):
     return lst, binpath
 
 
-def native_raw_iter(lst, binpath, threads, shape=(3, 227, 227)):
+def native_raw_iter(lst, binpath, threads, shape=(3, 227, 227), u8=False):
     from cxxnet_tpu.io.native import NativeImageBinIterator
     it = NativeImageBinIterator()
     for k, v in [("image_list", lst), ("image_bin", binpath),
                  ("batch_size", "256"),
                  ("input_shape", ",".join(map(str, shape))),
                  ("decode_thread_num", str(threads)), ("silent", "1"),
-                 ("round_batch", "1")]:
+                 ("round_batch", "1"), ("output_u8", str(int(u8)))]:
         it.set_param(k, v)
     it.init()
     return it
@@ -134,7 +134,13 @@ def main():
           f"{os.path.getsize(rbin)/1e6:.0f} MB packed")
     for threads in (0, 2, 4):
         r = bench_iter(native_raw_iter(rlst, rbin, threads))
-        print(f"native loader RAW-U8, {threads:2d} threads: "
+        print(f"native loader RAW->f32, {threads:2d} threads: "
+              f"{r:8.0f} imgs/sec")
+    # output_u8: no float conversion on the host at all (device-side
+    # normalization path) — the pure page-stream + memcpy ceiling
+    for threads in (0, 2):
+        r = bench_iter(native_raw_iter(rlst, rbin, threads, u8=True))
+        print(f"native loader RAW->u8,  {threads:2d} threads: "
               f"{r:8.0f} imgs/sec")
     if raw_only:
         return

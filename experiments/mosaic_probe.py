@@ -1,0 +1,144 @@
+"""Probe which strided-access forms Mosaic supports on real TPU.
+
+Each candidate is a tiny kernel; print compile ok/fail + a timing.
+The pool kernels need: strided READ along the sublane (W) axis, and
+ideally a strided WRITE (or a cheap interleave) for the backward.
+"""
+import functools
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C, W, N = 8, 64, 128
+OW = W // 2
+
+
+def run(name, kern, out_shape, *args):
+    try:
+        f = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.bfloat16),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)
+                      for _ in args],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+        r = jax.jit(f)(*args)
+        r.block_until_ready()
+        print(f"{name:40s} OK   {r.shape}")
+        return r
+    except Exception as e:
+        msg = str(e).split("\n")[0][:110]
+        print(f"{name:40s} FAIL {msg}")
+        return None
+
+
+def main():
+    x = jax.random.normal(jax.random.PRNGKey(0), (C, W, N),
+                          jnp.float32).astype(jnp.bfloat16)
+
+    def k_lax_slice(x_ref, o_ref):
+        v = lax.slice(x_ref[...], (0, 0, 0), (C, W - 1, N), (1, 2, 1))
+        o_ref[...] = v
+
+    run("lax.slice stride2 sublane 3D", k_lax_slice, (C, OW, N), x)
+
+    def k_lax_slice2d(x_ref, o_ref):
+        for c in range(C):
+            v = lax.slice(x_ref[c], (0, 0), (W - 1, N), (2, 1))
+            o_ref[c] = v
+
+    run("lax.slice stride2 sublane 2D/chan", k_lax_slice2d, (C, OW, N), x)
+
+    def k_jnp_idx2d(x_ref, o_ref):
+        for c in range(C):
+            o_ref[c] = x_ref[c][0:W - 1:2]
+
+    run("jnp [0:W-1:2] 2D per chan", k_jnp_idx2d, (C, OW, N), x)
+
+    def k_ref_strided_read(x_ref, o_ref):
+        o_ref[...] = x_ref[:, 0:W - 1:2, :]
+
+    run("ref strided read 3D", k_ref_strided_read, (C, OW, N), x)
+
+    def k_roll(x_ref, o_ref):
+        o_ref[...] = jnp.maximum(x_ref[...],
+                                 pltpu.roll(x_ref[...], -1, 1))[:, :OW]
+
+    run("pltpu.roll sublane", k_roll, (C, OW, N), x)
+
+    # strided WRITE forms
+    y = jax.random.normal(jax.random.PRNGKey(1), (C, OW, N),
+                          jnp.float32).astype(jnp.bfloat16)
+
+    def k_strided_store(y_ref, o_ref):
+        o_ref[...] = jnp.zeros((C, W, N), jnp.bfloat16)
+        o_ref[:, 0:W - 1:2, :] = y_ref[...]
+
+    run("ref strided store 3D", k_strided_store, (C, W, N), y)
+
+    def k_at_add(y_ref, o_ref):
+        z = jnp.zeros((C, W, N), jnp.float32)
+        z = z.at[:, 0:W - 1:2, :].add(y_ref[...].astype(jnp.float32))
+        o_ref[...] = z.astype(jnp.bfloat16)
+
+    run("jnp .at[::2].add 3D", k_at_add, (C, W, N), y)
+
+    # interleave two phases via reshape (W/2, 2) -> W on sublane-major
+    def k_interleave(y_ref, o_ref):
+        a = y_ref[...]
+        b = a * 2.0
+        st = jnp.stack([a, b], axis=2)          # (C, OW, 2, N)
+        o_ref[...] = st.reshape(C, W, N)
+
+    run("stack+reshape interleave", k_interleave, (C, W, N), y)
+
+    # dynamic row index (needed for bwd p-block rows)
+    def k_dyn_row(x_ref, o_ref):
+        i = pl.program_id(0) if False else 3
+        o_ref[...] = x_ref[:, pl.ds(i, OW), :]
+
+    run("pl.ds row window", k_dyn_row, (C, OW, N), x)
+
+
+if __name__ == "__main__":
+    main()
+
+
+def extra():
+    x = jax.random.normal(jax.random.PRNGKey(0), (C, W, N),
+                          jnp.float32).astype(jnp.bfloat16)
+
+    def k_deinterleave(x_ref, o_ref):
+        v = x_ref[...].reshape(C, W // 2, 2, N)
+        o_ref[...] = v[:, :, 0, :]
+
+    run("reshape-split deinterleave", k_deinterleave, (C, OW, N), x)
+
+    def k_deinterleave_both(x_ref, o_ref):
+        v = x_ref[...].reshape(C, W // 2, 2, N)
+        o_ref[...] = jnp.maximum(v[:, :, 0, :], v[:, :, 1, :])
+
+    run("deinterleave both phases + max", k_deinterleave_both,
+        (C, OW, N), x)
+
+    def k_roll_pos(x_ref, o_ref):
+        o_ref[...] = jnp.maximum(x_ref[...],
+                                 pltpu.roll(x_ref[...], 1, 1))[:, :OW]
+
+    run("pltpu.roll +1 sublane", k_roll_pos, (C, OW, N), x)
+
+    def k_shift_slice(x_ref, o_ref):
+        # static slice (shift by 1 along sublane, no stride)
+        o_ref[...] = jnp.maximum(x_ref[:, 0:OW, :], x_ref[:, 1:OW + 1, :])
+
+    run("unit-stride shifted slices + max", k_shift_slice, (C, OW, N), x)
+
+
+extra()

@@ -1,16 +1,17 @@
-"""Feasibility probe: fused relu+maxpool Pallas kernel in (C, H, W, N).
+"""Fused relu+maxpool Pallas kernels in (C, H, W, N) — batch in lanes.
 
-The round-3 kernel plan puts batch in lanes (N=128 multiples) and spatial
-dims on freely-sliced major/sublane axes.  Blocks carry FULL (H, W) per
-(C-tile, N-tile) program — H*W*128 fits VMEM for every geometry in the
-zoo — so windows are all-static slices; the only Mosaic unknown is the
-STRIDED sublane access along W (x[..., j::s, :]).
+Mosaic on v5e rejects strided sublane slices (they lower to gather), but
+supports reshape-SPLITTING the sublane dim ((C, W, N) -> (C, W/s, s, N))
+and stack+reshape interleaving back — measured by
+experiments/mosaic_probe.py.  So stride-s window access is expressed as
+phase deinterleave + unit-stride shifted slices, and the backward's
+strided placement as per-phase accumulators + interleave.
 
-Times, on the AlexNet pool1 geometry (96, 55, 55, 1024):
-  1. XLA reduce_window relu+pool in CHWN        (the no-kernel baseline)
-  2. Pallas fused relu+pool fwd                 (strided sublane slices)
-  3. Pallas fused bwd: eq-mask all-ties unpool + relu mask
-  4. XLA select-and-scatter bwd in CHWN         (the SAS baseline)
+Blocks carry FULL (H, W) per (C-tile, N-tile) program (H*W*128 fits VMEM
+for every geometry in the zoo), so row access is static indexing.
+
+Timed against XLA reduce_window / select-and-scatter in the same CHWN
+layout, AlexNet pool1 geometry by default.
 
 Usage: python experiments/pool_kernel_proto.py [C H W N k s]
 """
@@ -32,6 +33,8 @@ except ImportError:
 
 from experiments.mb_util import bench_op
 
+NEG = -1e30
+
 
 def pool_out(i, k, s):
     return min(i - k + s - 1, i - 1) // s + 1
@@ -39,53 +42,87 @@ def pool_out(i, k, s):
 
 def _pick_cb(c, h, w, n_lanes, itemsize, budget=3 << 20):
     cb = max(1, budget // max(h * w * n_lanes * itemsize, 1))
+    cb = min(cb, c)
     while c % cb:
         cb -= 1
     return cb
 
 
+def _phases(row, s, wpad, fill):
+    """(CB, W, N) -> s phase views (CB, W/s, N): row[c, p + s*q, n] =
+    phases[p][c, q, n].  Pads W up to wpad (multiple of s) with fill."""
+    cb, w, n = row.shape
+    if w < wpad:
+        pad = jnp.full((cb, wpad - w, n), fill, row.dtype)
+        row = jnp.concatenate([row, pad], axis=1)
+    v = row.reshape(cb, wpad // s, s, n)
+    return [v[:, :, p, :] for p in range(s)]
+
+
 # ---------------------------------------------------------------- kernels
-def _fwd_kernel(x_ref, o_ref, *, k, s, oh, ow):
-    a = jnp.maximum(x_ref[...], 0.0)          # (CB, H, W, NB)
-    rows = []
+def _fwd_kernel(x_ref, o_ref, *, k, s, oh, ow, wpad):
+    """relu + k x k / s max pool over full-(H, W) blocks."""
     for r in range(oh):
         acc = None
         for i in range(k):
-            xr = a[:, s * r + i]              # (CB, W, NB)
+            row = jnp.maximum(x_ref[:, s * r + i], 0.0)   # (CB, W, NB)
+            ph = _phases(row, s, wpad, NEG)
             for j in range(k):
-                v = xr[:, j:j + (ow - 1) * s + 1:s]   # strided sublane
+                v = ph[j % s][:, j // s:j // s + ow]
                 acc = v if acc is None else jnp.maximum(acc, v)
-        rows.append(acc)
-    o_ref[...] = jnp.stack(rows, axis=1).astype(o_ref.dtype)
+        o_ref[:, r] = acc.astype(o_ref.dtype)
 
 
-def _bwd_kernel(x_ref, p_ref, dp_ref, dx_ref, *, k, s, oh, ow):
-    """eq-mask (all-ties) unpool + relu mask: one pass, full H in block."""
-    x = x_ref[...]
-    a = jnp.maximum(x, 0.0)
-    zero = jnp.zeros((), jnp.float32)
-    h = x.shape[1]
-    row_acc = [None] * h
-    for r in range(oh):
-        pv = p_ref[:, r]                      # (CB, OW, NB)
-        dv = dp_ref[:, r].astype(jnp.float32)
+def _bwd_kernel(x_ref, p_ref, dp_ref, dx_ref, *, k, s, oh, ow, wpad):
+    """eq-mask (all-ties mshadow unpool) + relu mask, one pass.
+
+    For each input row h, dx[h] sums contributions from output rows r
+    with s*r <= h < s*r + k; within a row, contributions to position
+    w = j + s*t accumulate per phase (w mod s) and interleave back.
+    """
+    h = x_ref.shape[1]
+    wq = wpad // s
+    for hrow in range(h):
+        x_row = x_ref[:, hrow]
+        # compare in f32: Mosaic rejects bf16 eq on the deinterleaved
+        # (sublane-split) vector layout ("target does not support this
+        # comparison"); the cast is free relative to the HBM traffic
+        a_row = jnp.maximum(x_row.astype(jnp.float32), 0.0)
+        ph = _phases(a_row, s, wpad, NEG)
+        acc = [None] * s
         for i in range(k):
-            hrow = s * r + i
-            ar = a[:, hrow]
+            r = hrow - i
+            if r < 0 or r % s or r // s >= oh:
+                continue
+            r //= s
+            pv = p_ref[:, r].astype(jnp.float32)           # (CB, OW, NB)
+            dv = dp_ref[:, r].astype(jnp.float32)
             for j in range(k):
-                av = ar[:, j:j + (ow - 1) * s + 1:s]
-                contrib = jnp.where(av == pv, dv, zero)
-                # place back on the row at strided positions: build a
-                # full-width row via interleave (scatter-free): positions
-                # j + s*t for t in [0, ow)
-                wide = jnp.zeros(ar.shape, jnp.float32)
-                wide = wide.at[:, j:j + (ow - 1) * s + 1:s].add(contrib)
-                row_acc[hrow] = wide if row_acc[hrow] is None \
-                    else row_acc[hrow] + wide
-    rows = [jnp.zeros(a[:, 0].shape, jnp.float32) if rc is None else rc
-            for rc in row_acc]
-    dx = jnp.stack(rows, axis=1)
-    dx_ref[...] = jnp.where(x > 0.0, dx, zero).astype(dx_ref.dtype)
+                q = j // s
+                av = ph[j % s][:, q:q + ow]
+                contrib = jnp.where(av == pv, dv, 0.0)
+                # place at phase j%s, offset q: pad to (CB, wq, NB);
+                # zero-width parts are dropped (Mosaic rejects 0-sized
+                # vectors)
+                cb, _, nb = contrib.shape
+                parts = []
+                if q:
+                    parts.append(jnp.zeros((cb, q, nb), jnp.float32))
+                parts.append(contrib)
+                if wq - q - ow:
+                    parts.append(jnp.zeros((cb, wq - q - ow, nb),
+                                           jnp.float32))
+                placed = parts[0] if len(parts) == 1 \
+                    else jnp.concatenate(parts, axis=1)
+                acc[j % s] = placed if acc[j % s] is None \
+                    else acc[j % s] + placed
+        zeros = jnp.zeros((x_row.shape[0], wq, x_row.shape[2]),
+                          jnp.float32)
+        parts = [zeros if a is None else a for a in acc]
+        wide = jnp.stack(parts, axis=2).reshape(
+            x_row.shape[0], wpad, x_row.shape[2])[:, :x_row.shape[1]]
+        dx_ref[:, hrow] = jnp.where(x_row.astype(jnp.float32) > 0.0,
+                                    wide, 0.0).astype(dx_ref.dtype)
 
 
 def _call(kern, x, outs_shape, in_arrays, cb, nb, interpret):
@@ -114,16 +151,18 @@ def pallas_relu_pool_fwd(x, k, s, *, nb=128, interpret=False):
     oh, ow = pool_out(h, k, s), pool_out(w, k, s)
     assert (oh - 1) * s + k == h and (ow - 1) * s + k == w, \
         "prototype: exact-cover pools only"
+    wpad = -(-w // s) * s
     cb = _pick_cb(c, h, w, nb, x.dtype.itemsize)
-    kern = functools.partial(_fwd_kernel, k=k, s=s, oh=oh, ow=ow)
+    kern = functools.partial(_fwd_kernel, k=k, s=s, oh=oh, ow=ow, wpad=wpad)
     return _call(kern, x, (c, oh, ow, n), [x], cb, nb, interpret)
 
 
 def pallas_relu_pool_bwd(x, p, dp, k, s, *, nb=128, interpret=False):
     c, h, w, n = x.shape
     oh, ow = p.shape[1], p.shape[2]
-    cb = _pick_cb(c, h, w, nb, 4)  # f32 accumulator dominates
-    kern = functools.partial(_bwd_kernel, k=k, s=s, oh=oh, ow=ow)
+    wpad = -(-w // s) * s
+    cb = _pick_cb(c, h, w, nb, 4)  # f32 accumulators dominate
+    kern = functools.partial(_bwd_kernel, k=k, s=s, oh=oh, ow=ow, wpad=wpad)
     return _call(kern, x, x.shape, [x, p, dp], cb, nb, interpret)
 
 
@@ -131,6 +170,11 @@ def pallas_relu_pool_bwd(x, p, dp, k, s, *, nb=128, interpret=False):
 def xla_relu_pool_chwn(x, k, s):
     return lax.reduce_window(jnp.maximum(x, 0.0), -jnp.inf, lax.max,
                              (1, k, k, 1), (1, s, s, 1), "VALID")
+
+
+def xla_relu_pool_nchw(x, k, s):
+    return lax.reduce_window(jnp.maximum(x, 0.0), -jnp.inf, lax.max,
+                             (1, 1, k, k), (1, 1, s, s), "VALID")
 
 
 def main():
@@ -142,7 +186,7 @@ def main():
     x = jax.random.normal(jax.random.PRNGKey(0), (c, h, w, n),
                           jnp.float32).astype(jnp.bfloat16)
 
-    # correctness vs XLA first (small slice, interpret off-TPU)
+    # correctness first (small slice; interpret off-TPU)
     xs = x[:8, :, :, :256]
     want = xla_relu_pool_chwn(xs, k, s)
     got = pallas_relu_pool_fwd(xs, k, s, interpret=not on_tpu)
@@ -179,6 +223,14 @@ def main():
     t = bench_op(lambda a: pallas_relu_pool_fwd(a, k, s), x)
     print(f"PALL relu+pool fwd CHWN: {t:.3f} ms")
 
+    x_nchw = jnp.transpose(x, (3, 0, 1, 2))
+    t = bench_op(lambda a: xla_relu_pool_nchw(a, k, s), x_nchw)
+    print(f"XLA  relu+pool fwd NCHW: {t:.3f} ms")
+    t = bench_op(
+        lambda a: pallas_relu_pool_fwd(
+            jnp.transpose(a, (1, 2, 3, 0)), k, s), x_nchw)
+    print(f"PALL fwd w/ NCHW->CHWN transpose in-line: {t:.3f} ms")
+
     p_full = xla_relu_pool_chwn(x, k, s)
     dp_full = jax.random.normal(jax.random.PRNGKey(2), p_full.shape,
                                 jnp.float32).astype(jnp.bfloat16)
@@ -189,6 +241,13 @@ def main():
 
     t = bench_op(sas_bwd, x, dp_full)
     print(f"XLA  SAS bwd CHWN:       {t:.3f} ms")
+
+    def sas_bwd_nchw(a, g):
+        _, vjp = jax.vjp(lambda v: xla_relu_pool_nchw(v, k, s), a)
+        return vjp(g)[0]
+
+    t = bench_op(sas_bwd_nchw, x_nchw, jnp.transpose(dp_full, (3, 0, 1, 2)))
+    print(f"XLA  SAS bwd NCHW:       {t:.3f} ms")
     t = bench_op(lambda a, pp, g: pallas_relu_pool_bwd(a, pp, g, k, s),
                  x, p_full, dp_full)
     print(f"PALL eq bwd CHWN:        {t:.3f} ms")

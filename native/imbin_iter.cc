@@ -46,7 +46,8 @@
 namespace cxn {
 
 struct Batch {
-  std::vector<float> data;          // (batch, c, h, w)
+  std::vector<float> data;          // (batch, c, h, w); empty in u8 mode
+  std::vector<unsigned char> du8;   // u8 mode (output_u8=1): raw bytes
   std::vector<float> label;         // (batch, label_width)
   std::vector<uint64_t> index;      // (batch,)
   uint32_t num_batch_padd = 0;
@@ -98,6 +99,42 @@ static bool DecodeJpeg(const char* buf, size_t len, int c, int h, int w,
   return true;
 }
 
+// decode jpeg -> CHW u8 (RGB); the device-side-normalization path
+// (output_u8=1) never touches floats on the host
+static bool DecodeJpeg8(const char* buf, size_t len, int c, int h, int w,
+                        unsigned char* out) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, reinterpret_cast<const unsigned char*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = (c == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if ((int)cinfo.output_width != w || (int)cinfo.output_height != h ||
+      (int)cinfo.output_components != c) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  std::vector<unsigned char> row(w * c);
+  unsigned char* rowp = row.data();
+  for (int y = 0; y < h; ++y) {
+    jpeg_read_scanlines(&cinfo, &rowp, 1);
+    for (int x = 0; x < w; ++x)
+      for (int ch = 0; ch < c; ++ch)
+        out[((size_t)ch * h + y) * w + x] = row[x * c + ch];
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
 class ImbinIterator {
  public:
   bool Init(const std::string& cfg_text, std::string* err) {
@@ -125,6 +162,10 @@ class ImbinIterator {
     seed_data_ = cfg.GetInt("seed_data", 0);
     scale_ = cfg.GetFloat("scale", 1.0);
     silent_ = cfg.GetInt("silent", 0);
+    // output_u8=1: emit raw u8 batches; mean/scale normalization moves to
+    // the device (fuses into conv1), host memcpy traffic drops 4x and the
+    // host<->device transfer halves vs bf16 (quarters vs f32)
+    output_u8_ = cfg.GetInt("output_u8", 0);
     // decode fan-out (reference iter_thread_imbin_x decoder threads);
     // 0 = decode inline on the producer.  Default: half the cores — jpeg
     // decode at ~1-3 ms/image single-threaded cannot feed a ~20k imgs/sec
@@ -254,8 +295,9 @@ class ImbinIterator {
     exhausted_ = false;
   }
 
-  // 1 = batch written, 0 = epoch end
-  int NextBatch(float* data, float* label, uint64_t* index,
+  // 1 = batch written, 0 = epoch end.  ``data`` points at float or u8
+  // storage depending on output_u8 (the wrapper queries IsU8).
+  int NextBatch(void* data, float* label, uint64_t* index,
                 uint32_t* num_batch_padd) {
     if (exhausted_) return 0;
     Batch b = queue_.Pop();
@@ -263,12 +305,14 @@ class ImbinIterator {
       exhausted_ = true;
       return 0;
     }
-    std::memcpy(data, b.data.data(), b.data.size() * sizeof(float));
+    std::memcpy(data, bytes(b), (size_t)batch_size_ * inst_bytes());
     std::memcpy(label, b.label.data(), b.label.size() * sizeof(float));
     std::memcpy(index, b.index.data(), b.index.size() * sizeof(uint64_t));
     *num_batch_padd = b.num_batch_padd;
     return 1;
   }
+
+  bool output_u8() const { return output_u8_ != 0; }
 
   int batch_size() const { return batch_size_; }
   int c() const { return c_; }
@@ -314,6 +358,30 @@ class ImbinIterator {
         o[i] = (o[i] - m) * (float)scale_;
     }
     return true;
+  }
+
+  // u8-mode decode: raw u8 records are a straight memcpy, jpegs decode
+  // without any float pass; f32 records cannot be emitted as u8
+  bool DecodeInto8(const std::vector<char>& rec, unsigned char* out) {
+    const size_t n = inst_size();
+    if (rec.size() == n) {
+      std::memcpy(out, rec.data(), n);
+    } else if (rec.size() >= 2 && (unsigned char)rec[0] == 0xFF &&
+               (unsigned char)rec[1] == 0xD8) {
+      if (!DecodeJpeg8(rec.data(), rec.size(), c_, h_, w_, out))
+        return false;
+    } else {
+      return false;  // f32 records have no faithful u8 form
+    }
+    return true;
+  }
+
+  // batch data as raw bytes (mode-independent copies for pad/wrap paths)
+  char* bytes(Batch& b) const {
+    return output_u8_ ? (char*)b.du8.data() : (char*)b.data.data();
+  }
+  size_t inst_bytes() const {
+    return inst_size() * (output_u8_ ? 1 : sizeof(float));
   }
 
   // Stream shards/pages in (shuffled) order, calling
@@ -407,8 +475,14 @@ class ImbinIterator {
       }
       // stale generations skip the decode but still release the slot
       if (job.gen == gen_.load()) {
-        float* out = job.slot->batch.data.data() + job.row * inst_size();
-        if (!DecodeInto(job.rec, out)) job.slot->failed = true;
+        bool ok;
+        if (output_u8_)
+          ok = DecodeInto8(job.rec, job.slot->batch.du8.data()
+                           + job.row * inst_size());
+        else
+          ok = DecodeInto(job.rec, job.slot->batch.data.data()
+                          + job.row * inst_size());
+        if (!ok) job.slot->failed = true;
       }
       job.slot->Done();
     }
@@ -427,7 +501,10 @@ class ImbinIterator {
 
   std::shared_ptr<DecodeSlot> NewSlot() {
     auto s = std::make_shared<DecodeSlot>();
-    s->batch.data.resize((size_t)batch_size_ * inst_size());
+    if (output_u8_)
+      s->batch.du8.resize((size_t)batch_size_ * inst_size());
+    else
+      s->batch.data.resize((size_t)batch_size_ * inst_size());
     s->batch.label.resize((size_t)batch_size_ * label_width_);
     s->batch.index.resize(batch_size_);
     return s;
@@ -442,8 +519,9 @@ class ImbinIterator {
   void Produce(uint64_t gen) {
     std::mt19937_64 rng(787 + seed_data_ + gen);
     const bool pooled = decode_threads_ > 0;
-    // head cache for round_batch wrap (first batch_size instances)
-    std::vector<float> head_data((size_t)batch_size_ * inst_size());
+    // head cache for round_batch wrap (first batch_size instances);
+    // byte-typed so float and u8 output modes share the copy paths
+    std::vector<char> head_data((size_t)batch_size_ * inst_bytes());
     std::vector<float> head_label((size_t)batch_size_ * label_width_);
     std::vector<uint64_t> head_index(batch_size_);
     size_t head_n = 0;
@@ -453,10 +531,9 @@ class ImbinIterator {
     size_t top = 0;
     bool ok = true;
 
-    auto cache_head = [&](const Batch& b) {
+    auto cache_head = [&](Batch& b) {
       if (head_n) return;
-      std::memcpy(head_data.data(), b.data.data(),
-                  head_data.size() * sizeof(float));
+      std::memcpy(head_data.data(), bytes(b), head_data.size());
       std::memcpy(head_label.data(), b.label.data(),
                   head_label.size() * sizeof(float));
       std::copy(b.index.begin(), b.index.end(), head_index.begin());
@@ -482,9 +559,14 @@ class ImbinIterator {
       b.index[top] = indices_[gidx];
       if (pooled) {
         Dispatch(std::move(rec), cur, top, gen);
-      } else if (!DecodeInto(rec, b.data.data() + top * inst_size())) {
-        run_err_ = "record decode failed (size/format mismatch)";
-        return false;
+      } else {
+        bool dok = output_u8_
+            ? DecodeInto8(rec, b.du8.data() + top * inst_size())
+            : DecodeInto(rec, b.data.data() + top * inst_size());
+        if (!dok) {
+          run_err_ = "record decode failed (size/format mismatch)";
+          return false;
+        }
       }
       if (++top == (size_t)batch_size_) {
         top = 0;
@@ -512,9 +594,9 @@ class ImbinIterator {
       } else {
         size_t need = batch_size_ - top;
         for (size_t i = 0; i < need; ++i) {
-          std::memcpy(b.data.data() + (top + i) * inst_size(),
-                      b.data.data() + (top - 1) * inst_size(),
-                      inst_size() * sizeof(float));
+          std::memcpy(bytes(b) + (top + i) * inst_bytes(),
+                      bytes(b) + (top - 1) * inst_bytes(),
+                      inst_bytes());
           std::memcpy(b.label.data() + (top + i) * label_width_,
                       b.label.data() + (top - 1) * label_width_,
                       label_width_ * sizeof(float));
@@ -532,8 +614,8 @@ class ImbinIterator {
         if (head_n == 0) {
           // dataset smaller than one batch: the tail rows ARE the stream's
           // first instances — they serve as the wrap head
-          std::memcpy(head_data.data(), b.data.data(),
-                      top * inst_size() * sizeof(float));
+          std::memcpy(head_data.data(), bytes(b),
+                      top * inst_bytes());
           std::memcpy(head_label.data(), b.label.data(),
                       top * label_width_ * sizeof(float));
           std::copy(b.index.begin(), b.index.begin() + top,
@@ -543,9 +625,9 @@ class ImbinIterator {
         size_t need = batch_size_ - top;
         if (need <= head_n) {
           for (size_t i = 0; i < need; ++i) {
-            std::memcpy(b.data.data() + (top + i) * inst_size(),
-                        head_data.data() + i * inst_size(),
-                        inst_size() * sizeof(float));
+            std::memcpy(bytes(b) + (top + i) * inst_bytes(),
+                        head_data.data() + i * inst_bytes(),
+                        inst_bytes());
             std::memcpy(b.label.data() + (top + i) * label_width_,
                         head_label.data() + i * label_width_,
                         label_width_ * sizeof(float));
@@ -565,6 +647,7 @@ class ImbinIterator {
 
   int batch_size_ = 0, c_ = 0, h_ = 0, w_ = 0, label_width_ = 1;
   long shuffle_ = 0, round_batch_ = 0, seed_data_ = 0, silent_ = 0;
+  long output_u8_ = 0;
   long decode_threads_ = 0;
   std::vector<std::thread> pool_;
   std::deque<DecodeJob> jobs_;
@@ -623,6 +706,18 @@ int CXNIONativeNextBatch(void* h, float* data, float* label,
                          uint64_t* index, uint32_t* num_batch_padd) {
   return static_cast<cxn::ImbinIterator*>(h)->NextBatch(
       data, label, index, num_batch_padd);
+}
+
+// u8-mode batch fetch (output_u8=1); `data` must hold batch*c*h*w bytes
+int CXNIONativeNextBatchU8(void* h, unsigned char* data, float* label,
+                           uint64_t* index, uint32_t* num_batch_padd) {
+  return static_cast<cxn::ImbinIterator*>(h)->NextBatch(
+      data, label, index, num_batch_padd);
+}
+
+// 1 when the iterator emits u8 batches (use NextBatchU8)
+int CXNIONativeIsU8(void* h) {
+  return static_cast<cxn::ImbinIterator*>(h)->output_u8() ? 1 : 0;
 }
 
 // shape query: out = [batch_size, c, h, w, label_width, num_inst]

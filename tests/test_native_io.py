@@ -390,3 +390,71 @@ def test_native_error_cleared_on_restart(tmp_path):
     b = it.next()
     assert b is not None and b.tail_mask_padd == 1
     assert it.next() is None  # clean end, no stale error
+
+
+def test_native_u8_output_mode(tmp_path):
+    """output_u8=1 emits raw uint8 batches (device-side normalization
+    path): same instances/order as the float path, no mean/scale applied
+    on the host."""
+    it8 = make_native(tmp_path, extra="output_u8 = 1")
+    itf = make_native(tmp_path / ".." / (tmp_path.name), extra="")
+    b8s = collect_epoch(it8)
+    bfs = collect_epoch(itf)
+    assert len(b8s) == len(bfs) == 6
+    for b8, bf in zip(b8s, bfs):
+        assert b8.data.dtype == np.uint8
+        np.testing.assert_array_equal(b8.data.astype(np.float32), bf.data)
+        np.testing.assert_array_equal(b8.label, bf.label)
+        np.testing.assert_array_equal(b8.index, bf.index)
+        assert b8.tail_mask_padd == bf.tail_mask_padd
+
+
+def test_u8_device_normalization_matches_host(tmp_path):
+    """Training on u8 batches with trainer-side (x-mean)*scale must match
+    training on host-normalized float batches bit-for-... closely."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    from cxxnet_tpu.io.data import DataBatch
+
+    CONF = """
+netconfig=start
+layer[+1] = flatten
+layer[+1] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 4
+dev = cpu
+eta = 0.1
+mean_value = 10,20,30
+scale = 0.01
+silent = 1
+"""
+
+    def trainer():
+        t = NetTrainer()
+        for k, v in parse_config_string(CONF):
+            t.set_param(k, v)
+        t.init_model()
+        return t
+
+    rnd = np.random.RandomState(0)
+    raw = rnd.randint(0, 255, (4, 3, 8, 8)).astype(np.uint8)
+    label = rnd.randint(0, 4, (4, 1)).astype(np.float32)
+    mean = np.array([10, 20, 30], np.float32).reshape(1, 3, 1, 1)
+    host_norm = (raw.astype(np.float32) - mean) * 0.01
+
+    tu = trainer()
+    tf = trainer()
+    tu.update(DataBatch(data=raw, label=label,
+                        index=np.arange(4, dtype=np.uint32)))
+    tf.update(DataBatch(data=host_norm, label=label,
+                        index=np.arange(4, dtype=np.uint32)))
+    for pkey in tu.params:
+        for tag, v in tu.params[pkey].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(tf.params[pkey][tag]),
+                                       rtol=1e-6, atol=1e-7,
+                                       err_msg=f"{pkey}/{tag}")
