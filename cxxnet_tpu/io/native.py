@@ -51,6 +51,12 @@ def load_library() -> ctypes.CDLL:
                 f"({out.decode(errors='replace')[-500:]}); build it manually "
                 "or use the pure-Python `iter = imgbin`") from e
     lib = ctypes.CDLL(_LIB_PATH)
+    if not hasattr(lib, "CXNIONativeIsU8"):
+        # stale pre-u8 build on disk: rebuild once and reload (a missing
+        # symbol would otherwise surface as a bare AttributeError)
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
+                       check=True, capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
     lib.CXNIONativeCreate.restype = ctypes.c_void_p
     lib.CXNIONativeCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                       ctypes.c_int]

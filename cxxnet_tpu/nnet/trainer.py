@@ -286,18 +286,22 @@ class NetTrainer:
         self.buffers = jax.device_put(self.buffers, self.buffer_shardings)
 
     # ----------------------------------------------------------- step build
+    def _normalize_input(self, data):
+        """Device-side normalization of raw u8 batches (output_u8=1):
+        (x - mean_value[c]) * scale, matching the host iterators' SetData
+        rule; fuses into the first conv's input read."""
+        if data.dtype != jnp.uint8:
+            return data
+        x = data.astype(jnp.float32)
+        if self.input_mean is not None:
+            x = x - jnp.asarray(self.input_mean).reshape(1, -1, 1, 1)
+        if self.input_scale != 1.0:
+            x = x * self.input_scale
+        return x
+
     def _forward(self, params, buffers, data, label_vec, extras, *, train,
                  rng, epoch, mask=None):
-        if data.dtype == jnp.uint8:
-            # device-side normalization of raw u8 batches (output_u8=1):
-            # (x - mean_value[c]) * scale, matching the host iterators'
-            # SetData rule; fuses into the first conv's input read
-            x = data.astype(jnp.float32)
-            if self.input_mean is not None:
-                x = x - jnp.asarray(self.input_mean).reshape(1, -1, 1, 1)
-            if self.input_scale != 1.0:
-                x = x * self.input_scale
-            data = x
+        data = self._normalize_input(data)
         fields = {name: label_vec[:, a:b]
                   for name, a, b in self._label_fields} if label_vec is not None else {}
         ctx = ForwardContext(train=train, rng=rng,
@@ -341,6 +345,7 @@ class NetTrainer:
         stage_fns = pipeline_net.make_stage_fns(
             self.net, stages, body_end, train=train, epoch=epoch,
             loss_scale=self.loss_scale, rng=rng)
+        data = self._normalize_input(data)
         b = data.shape[0]
         n_micro = self.pipe_microbatch or 2 * self.mesh.shape["pipe"]
         assert b % n_micro == 0, (

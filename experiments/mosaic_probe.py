@@ -107,10 +107,6 @@ def main():
     run("pl.ds row window", k_dyn_row, (C, OW, N), x)
 
 
-if __name__ == "__main__":
-    main()
-
-
 def extra():
     x = jax.random.normal(jax.random.PRNGKey(0), (C, W, N),
                           jnp.float32).astype(jnp.bfloat16)
@@ -141,4 +137,6 @@ def extra():
     run("unit-stride shifted slices + max", k_shift_slice, (C, OW, N), x)
 
 
-extra()
+if __name__ == "__main__":
+    main()
+    extra()

@@ -29,12 +29,13 @@ def run_traced(tracedir, batch=1024, scan_len=6, model="alexnet"):
         shape = (3, 224, 224)
     t = _make_trainer(conf, batch, "tpu",
                       extra=[("dtype", "bfloat16"), ("eval_train", "0")])
-    rnd = np.random.RandomState(0)
-    datas = jnp.asarray(
-        rnd.rand(scan_len, batch, *shape).astype(np.float32)
-    ).astype(jnp.bfloat16)
-    labels = jnp.asarray(
-        rnd.randint(0, 1000, (scan_len, batch, 1)).astype(np.float32))
+    # generate on DEVICE (the tunneled host link + single host core must
+    # not gate the profiled region)
+    kd, kl = jax.random.split(jax.random.PRNGKey(0))
+    datas = jax.jit(lambda k: jax.random.uniform(
+        k, (scan_len, batch, *shape), jnp.float32).astype(jnp.bfloat16))(kd)
+    labels = jax.jit(lambda k: jax.random.randint(
+        k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)
     t.start_round(1)
     np.asarray(t.update_many(datas, labels))  # compile+warm
     import time

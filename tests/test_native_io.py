@@ -397,7 +397,7 @@ def test_native_u8_output_mode(tmp_path):
     path): same instances/order as the float path, no mean/scale applied
     on the host."""
     it8 = make_native(tmp_path, extra="output_u8 = 1")
-    itf = make_native(tmp_path / ".." / (tmp_path.name), extra="")
+    itf = make_native(tmp_path, extra="")  # same dataset files
     b8s = collect_epoch(it8)
     bfs = collect_epoch(itf)
     assert len(b8s) == len(bfs) == 6
@@ -458,3 +458,35 @@ silent = 1
                                        np.asarray(tf.params[pkey][tag]),
                                        rtol=1e-6, atol=1e-7,
                                        err_msg=f"{pkey}/{tag}")
+
+
+def test_native_jpeg_u8_records(tmp_path):
+    """jpeg + output_u8: DecodeJpeg8's planar deinterleave must match the
+    float decoder exactly (same pixels, u8 dtype)."""
+    cv2 = pytest.importorskip("cv2")
+    bin_p = str(tmp_path / "j.bin")
+    lst_p = str(tmp_path / "j.lst")
+    rnd = np.random.RandomState(7)
+    w = BinaryPageWriter(bin_p, page_size=1 << 14)
+    with open(lst_p, "w") as lf:
+        for i in range(6):
+            img = (rnd.rand(8, 8, 3) * 255).astype(np.uint8)
+            ok, enc = cv2.imencode(".jpg", img,
+                                   [cv2.IMWRITE_JPEG_QUALITY, 95])
+            assert ok
+            w.push(enc.tobytes())
+            lf.write(f"{i}\t{float(i)}\tf{i}.jpg\n")
+    w.close()
+
+    def make(extra):
+        cfg = [("iter", "imbin_native"), ("path_imgbin", bin_p),
+               ("path_imglst", lst_p), ("input_shape", "3,8,8"),
+               ("silent", "1")] + extra
+        return init_iterator(create_iterator(cfg), [("batch_size", "3")])
+
+    b8s = collect_epoch(make([("output_u8", "1")]))
+    bfs = collect_epoch(make([]))
+    assert len(b8s) == len(bfs) == 2
+    for b8, bf in zip(b8s, bfs):
+        assert b8.data.dtype == np.uint8
+        np.testing.assert_array_equal(b8.data.astype(np.float32), bf.data)
