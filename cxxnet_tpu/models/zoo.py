@@ -174,8 +174,34 @@ def _inception(lines: List[str], name: str, bottom: str,
     return name
 
 
-def googlenet(num_class: int = 1000) -> str:
-    """GoogLeNet v1, single head (no aux classifiers): 9 inception modules.
+def _aux_head(lines: List[str], name: str, bottom: str,
+              num_class: int) -> str:
+    """GoogLeNet v1 auxiliary classifier: avgpool5/s3 -> 1x1 conv 128 ->
+    fc1024 -> dropout 0.7 -> fc -> softmax at grad_scale 0.3.  Returns the
+    trunk-continuation node.  The aux gradient injection is what lets the
+    22-layer trunk train under plain SGD (measured: without the heads a
+    512-sample memorization stalls at loss ~5.9; with them it collapses)."""
+    main, aux = f"{name}_main", f"{name}_in"
+    lines += [f"layer[{bottom}->{main},{aux}] = split",
+              f"layer[{aux}->{name}_ap] = avg_pooling",
+              "  kernel_size = 5", "  stride = 3"]
+    _conv_relu(lines, f"{name}_ap", f"{name}_cv", f"{name}_conv", 128, 1)
+    lines += [f"layer[{name}_cv->{name}_fl] = flatten",
+              f"layer[{name}_fl->{name}_fc1] = fullc:{name}_fc1",
+              "  nhidden = 1024",
+              f"layer[+1:{name}_r] = relu",
+              f"layer[{name}_r->{name}_r] = dropout",
+              "  threshold = 0.7",
+              f"layer[{name}_r->{name}_fc2] = fullc:{name}_fc2",
+              f"  nhidden = {num_class}",
+              f"layer[{name}_fc2->{name}_fc2] = softmax",
+              "  grad_scale = 0.3"]
+    return main
+
+
+def googlenet(num_class: int = 1000, aux_heads: bool = True) -> str:
+    """GoogLeNet v1: 9 inception modules + the two auxiliary classifiers
+    (after i4a and i4d, grad_scale 0.3 — the v1 recipe).
 
     No reference config exists (SURVEY.md §6: config-to-write, not
     config-to-port); channel plan is the canonical v1 table.
@@ -199,9 +225,13 @@ def googlenet(num_class: int = 1000) -> str:
     lines += [f"layer[{top}->p3] = max_pooling",
               "  kernel_size = 3", "  stride = 2"]
     top = _inception(lines, "i4a", "p3", 192, 96, 208, 16, 48, 64)
+    if aux_heads:
+        top = _aux_head(lines, "aux1", top, num_class)
     top = _inception(lines, "i4b", top, 160, 112, 224, 24, 64, 64)
     top = _inception(lines, "i4c", top, 128, 128, 256, 24, 64, 64)
     top = _inception(lines, "i4d", top, 112, 144, 288, 32, 64, 64)
+    if aux_heads:
+        top = _aux_head(lines, "aux2", top, num_class)
     top = _inception(lines, "i4e", top, 256, 160, 320, 32, 128, 128)
     lines += [f"layer[{top}->p4] = max_pooling",
               "  kernel_size = 3", "  stride = 2"]

@@ -53,13 +53,20 @@ def partition_network(net, n_stage: int) -> Tuple[List[Tuple[int, int]], int]:
     stage boundary.
     """
     conns = net.connections
-    # body = everything before the first loss layer
+    # body = everything before the first loss layer; only TRAILING losses
+    # can form the post-pipeline tail
     body_end = len(conns)
     for i, c in enumerate(conns):
         if c.layer.is_loss:
             body_end = i
             break
     assert body_end > 0, "pipeline: network has no non-loss body"
+    non_loss_after = [i for i in range(body_end, len(conns))
+                      if not conns[i].layer.is_loss]
+    assert not non_loss_after, (
+        "pipeline: loss layers must all trail the network body — "
+        "mid-graph auxiliary heads (e.g. googlenet(aux_heads=True)) are "
+        "not partitionable; use aux_heads=False for pipeline runs")
     for c in conns[:body_end]:
         nb = c.layer.init_buffers(
             [net.node_shapes[n] for n in c.nindex_in])

@@ -24,12 +24,28 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-# LRN kernel dispatch: "1" routes through the Pallas kernel (interpreter mode
-# off-TPU).  Default is the XLA path: measured on v5e, the standalone Pallas
-# kernel wins (bwd 28% faster in isolation) but loses in a full AlexNet step
-# (26.4ms -> 28.6ms) because pallas_call is a fusion boundary — XLA fuses the
-# shifted-adds LRN into the surrounding pooling/conv elementwise work.
-_PALLAS_LRN = os.environ.get("CXXNET_PALLAS_LRN", "0")
+# LRN kernel dispatch.  Default "hwcn": the Pallas kernel in XLA's native
+# (H, W, C-sublane, N-lane) activation layout — the boundary transposes are
+# bitcasts, and the measured full-step win on v5e is 2.5 ms (53.6 -> 51.1,
+# AlexNet b1024; round 2's NCHW-boundary kernel LOST for exactly the
+# relayout reason this form avoids).  "1" = the legacy (N, C, HW) kernel,
+# "0" = pure XLA.  Shapes whose (W, C, 128-lane) f32 working set exceeds
+# VMEM fall back to XLA automatically.
+_PALLAS_LRN = os.environ.get("CXXNET_PALLAS_LRN", "hwcn")
+
+
+def _lrn_hwcn_fits(shape) -> bool:
+    # empirical win region (v5e): small-spatial LRN planes (AlexNet 27x27,
+    # 13x13: -2.5 ms/step) take the kernel; large-spatial planes
+    # (GoogLeNet 56x56: hb=1 single-row blocks, measured slower than XLA)
+    # stay on the XLA path.  Batches must fill the 128-lane tile: Mosaic
+    # pads the minor dim to 128 regardless of n, so a small-batch block
+    # would be 128/n times larger than the estimate (measured VMEM OOM at
+    # n=2) — and the layout-match argument only holds for lane-full
+    # batches anyway.
+    n, c, h, w = shape
+    return (jax.default_backend() == "tpu" and n % 128 == 0
+            and w <= 32 and w * c * 128 * 4 <= (3 << 20))
 
 
 def pool_out_size(in_size: int, ksize: int, stride: int) -> int:
@@ -154,7 +170,15 @@ def _conv_bias_fast_fwd(x, w, b, stride, pad_y, pad_x):
 def _conv_bias_fast_bwd(stride, pad_y, pad_x, res, dy):
     x, w = res
     co, ci, kh, kw = w.shape
-    if _FAST_WGRAD == "pallas":
+    if _FAST_WGRAD == "hwcn":
+        # native-layout Pallas kernel (lane-contraction dots; bias grad
+        # rides along) — the round-3 formulation that compiles on real TPU
+        from .pallas_kernels import conv_wgrad_hwcn_pallas
+        dw, db = conv_wgrad_hwcn_pallas(x, dy, kh=kh, kw=kw, stride=stride,
+                                        pad_y=pad_y, pad_x=pad_x)
+        dw = dw.astype(w.dtype)
+        db = db.astype(w.dtype)
+    elif _FAST_WGRAD == "pallas":
         from .pallas_kernels import conv_wgrad_s2d_pallas
         # interpret=True: Mosaic rejects the kernel's minor-dim reshapes on
         # real TPU (see conv_wgrad_s2d_pallas), so this mode is a
@@ -330,6 +354,12 @@ def _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x):
 
 def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
                pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    if (_POOL_LAYOUT == "hwcn" and pad_y == 0 and pad_x == 0
+            and ksize_y == ksize_x):
+        # Pallas kernels in XLA's native (H, W, C, N) activation layout:
+        # bitcast boundary, exact mshadow all-ties backward
+        from .pallas_kernels import max_pool_hwcn
+        return max_pool_hwcn(x, ksize_y, stride)
     if _POOL_LAYOUT == "chwn" and _POOL_BWD == "sas":
         xt = jnp.transpose(x, (1, 2, 3, 0))
         # reuse the NCHW padding/window logic by viewing (C, H, W, N) as
@@ -434,6 +464,11 @@ def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float
     if _PALLAS_LRN == "1":
         from .pallas_kernels import lrn_pallas
         return lrn_pallas(x, nsize, alpha, beta, knorm)
+    if _PALLAS_LRN == "hwcn" and _lrn_hwcn_fits(x.shape):
+        # kernel in XLA's native (H, W, C, N) activation layout — the
+        # boundary transposes are bitcasts, not relayouts
+        from .pallas_kernels import lrn_pallas_hwcn
+        return lrn_pallas_hwcn(x, nsize, alpha, beta, knorm)
     salpha = alpha / nsize
     norm = chpool_sum(jnp.square(x), nsize) * salpha + knorm
     if beta == 0.75:

@@ -18,12 +18,14 @@ in a second single-pass kernel via ``jax.custom_vjp``.
 Kernels run in interpreter mode off-TPU so the same code path is unit-tested
 on the CPU mesh (pallas_guide: ``interpret=True``).
 
-Measured on TPU v5e (AlexNet lrn1 shape, 512x96x27x27): standalone the Pallas
-backward is ~28% faster than the XLA path (5.2ms vs 7.2ms), but inside a full
-training step the ``pallas_call`` fusion boundary costs more than the kernel
-saves, so dispatch defaults to XLA (``CXXNET_PALLAS_LRN=1`` opts in; see
-``nn.lrn``).  The module earns its keep as the custom-kernel extension slot
-and as the pattern for future fused kernels.
+Round-2 measured the (N, C, HW)-layout kernel losing in-step to XLA: a
+pallas_call on a logical-NCHW activation forces a relayout (XLA keeps conv
+activations physically (H, W, C-sublane, N-lane), batch minor).  Round 3's
+``lrn_pallas_hwcn`` transposes to the MATCHING logical order first — the
+boundary becomes a bitcast — and wins ~2 ms/step on the AlexNet b1024
+config, so it is the default dispatch for lane-full batches in its
+measured win region (``CXXNET_PALLAS_LRN``: "hwcn" (default) / "1" legacy
+(N, C, HW) kernel / "0" pure XLA; see ``nn.lrn``).
 """
 
 from __future__ import annotations
@@ -156,6 +158,509 @@ def _lrn_bwd_res(nsize, alpha, beta, knorm, res, g):
 
 
 lrn_pallas.defvjp(_lrn_fwd_res, _lrn_bwd_res)
+
+
+# --------------------------------------------------------------------------
+# LRN in XLA's native activation layout.  Profiling the AlexNet step shows
+# XLA lays conv activations out as {0,1,3,2:T(8,128)} — physically
+# (H, W, C-sublane, N-lane), batch minor.  A pallas_call on the logical
+# NCHW array therefore forces a relayout (the round-2 kernel's measured
+# boundary toll); transposing to the MATCHING logical order (H, W, C, N)
+# first makes the transpose a layout-change XLA can satisfy with a bitcast,
+# and inside the kernel the channel window sits on the sublane axis where
+# shifted slices are natively supported (experiments/mosaic_probe2.py).
+
+
+def _halo_concat(center, lo_v, hi_v, bc, nblk, halo):
+    """Assemble the C-extended block: ``halo`` channels from each
+    neighbouring C-block, zero-masked at the array edges (LRN zero-pads).
+    The halo refs are 8-wide (sublane tile minimum); only the adjacent
+    ``halo`` channels are used."""
+    if not halo:
+        return center
+    parts = [jnp.where(bc > 0, lo_v[:, :, lo_v.shape[2] - halo:], 0.0),
+             center,
+             jnp.where(bc < nblk - 1, hi_v[:, :, :halo], 0.0)]
+    return jnp.concatenate(parts, axis=2)
+
+
+def _cshift(v, i):
+    """v shifted by i channels (axis 2), zero-filled (concat form —
+    Mosaic-safe)."""
+    if i == 0:
+        return v
+    z = jnp.zeros(v.shape[:2] + (abs(i),) + v.shape[3:], v.dtype)
+    if i > 0:
+        return jnp.concatenate([v[:, :, i:], z], axis=2)
+    return jnp.concatenate([z, v[:, :, :i]], axis=2)
+
+
+def _lrn_hwcn_fwd_kernel(x_ref, xlo_ref, xhi_ref, o_ref, *, nsize, salpha,
+                         beta, knorm, halo):
+    bc = pl.program_id(1)
+    nblk = pl.num_programs(1)
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    x = x_ref[...].astype(jnp.float32)        # (HB, W, CB, NB)
+    cb = x.shape[2]
+    xe = _halo_concat(x, xlo_ref[...].astype(jnp.float32),
+                      xhi_ref[...].astype(jnp.float32), bc, nblk, halo)
+    sq = xe * xe
+    # center channel j = extended channel halo + j; window [j-lo, j+hi]
+    acc = None
+    for i in range(nsize):
+        if halo:
+            sl = sq[:, :, halo - lo + i:halo - lo + i + cb]
+        else:  # untiled: zero-fill shifts instead of halo slices
+            sl = _cshift(sq, i - lo)
+        acc = sl if acc is None else acc + sl
+    norm = acc * salpha + knorm
+    o_ref[...] = (x * _norm_pow(norm, beta)).astype(o_ref.dtype)
+
+
+def _lrn_hwcn_bwd_kernel(x_ref, xlo_ref, xhi_ref, g_ref, glo_ref, ghi_ref,
+                         dx_ref, *, nsize, salpha, beta, knorm, halo):
+    bc = pl.program_id(1)
+    nblk = pl.num_programs(1)
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    x = x_ref[...].astype(jnp.float32)
+    cb = x.shape[2]
+    xe = _halo_concat(x, xlo_ref[...].astype(jnp.float32),
+                      xhi_ref[...].astype(jnp.float32), bc, nblk, halo)
+    ge = _halo_concat(g_ref[...].astype(jnp.float32),
+                      glo_ref[...].astype(jnp.float32),
+                      ghi_ref[...].astype(jnp.float32), bc, nblk, halo)
+    # norm on the extended block: valid wherever the window stays inside
+    # it — true for all channels the adjoint sum below touches, because
+    # halo >= lo + hi (edge zero-fill is the correct array-edge padding)
+    sq = xe * xe
+    norm_e = None
+    for i in range(-lo, hi + 1):
+        sl = _cshift(sq, i)
+        norm_e = sl if norm_e is None else norm_e + sl
+    norm_e = norm_e * salpha + knorm
+    npow_e = _norm_pow(norm_e, beta)
+    inner_e = ge * xe * (npow_e / norm_e)
+    x_c = xe[:, :, halo:halo + cb]
+    g_c = ge[:, :, halo:halo + cb]
+    npow_c = npow_e[:, :, halo:halo + cb]
+    # adjoint window swaps lo/hi: dx[j] -= 2ba x[j] sum_{i in [-hi, lo]}
+    # inner[j+i]
+    wsum = None
+    for i in range(-hi, lo + 1):
+        if halo:
+            sl = inner_e[:, :, halo + i:halo + i + cb]
+        else:
+            sl = _cshift(inner_e, i)
+        wsum = sl if wsum is None else wsum + sl
+    dx = g_c * npow_c - (2.0 * beta * salpha) * x_c * wsum
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+
+
+def _lrn_hwcn_fwd_kernel_u(x_ref, o_ref, *, nsize, salpha, beta, knorm):
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    x = x_ref[...].astype(jnp.float32)        # (HB, W, C, NB)
+    sq = x * x
+    acc = None
+    for i in range(-lo, hi + 1):
+        sl = _cshift(sq, i)
+        acc = sl if acc is None else acc + sl
+    norm = acc * salpha + knorm
+    o_ref[...] = (x * _norm_pow(norm, beta)).astype(o_ref.dtype)
+
+
+def _lrn_hwcn_bwd_kernel_u(x_ref, g_ref, dx_ref, *, nsize, salpha, beta,
+                           knorm):
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    sq = x * x
+    norm = None
+    for i in range(-lo, hi + 1):
+        sl = _cshift(sq, i)
+        norm = sl if norm is None else norm + sl
+    norm = norm * salpha + knorm
+    npow = _norm_pow(norm, beta)
+    inner = g * x * (npow / norm)
+    wsum = None
+    for i in range(-hi, lo + 1):
+        sl = _cshift(inner, i)
+        wsum = sl if wsum is None else wsum + sl
+    dx = g * npow - (2.0 * beta * salpha) * x * wsum
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+def _lrn_hwcn_call(kernel, out_dtype, nsize, salpha, beta, knorm, args,
+                   interpret):
+    h, w, c, n = args[0].shape
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    # bwd recomputes norms for halo channels, whose windows reach another
+    # lo+hi channels out — one halo width serves both kernels
+    halo = max(lo + hi, 1)
+    nb = 128 if n % 128 == 0 else n
+    # C-tile (halo channels from neighbour-block refs, zero-masked at the
+    # edges) only when the untiled per-block working set is too large;
+    # the untiled path skips the halo assembly entirely (fewer VMEM
+    # temporaries — measured: the AlexNet shapes prefer 2-row untiled
+    # blocks, GoogLeNet's 56x56 shapes need the C-tiling)
+    cb = c
+    while cb > 2 * halo and w * cb * nb * 4 > (3 << 20):
+        cb //= 2
+    while c % cb:
+        cb -= 1
+    hblk = 8  # halo refs are one sublane tile wide (>= any lo+hi here)
+    assert halo <= hblk, f"lrn nsize {nsize} halo {halo} exceeds tile"
+    if cb % hblk or cb < hblk:
+        cb = c  # halo-block indexing needs 8 | cb; fall back to whole C
+    nblk = c // cb
+    untiled = nblk == 1
+    if untiled:
+        halo = 0  # no neighbours: no halo refs, no extended temps
+        kernel = {_lrn_hwcn_fwd_kernel: _lrn_hwcn_fwd_kernel_u,
+                  _lrn_hwcn_bwd_kernel: _lrn_hwcn_bwd_kernel_u}[kernel]
+    plane = w * (cb + 2 * halo) * nb * 4
+    hb = max(1, (3 << 20) // max(plane, 1))
+    while h % hb:
+        hb -= 1
+    kern = functools.partial(kernel, nsize=nsize, salpha=salpha, beta=beta,
+                             knorm=knorm,
+                             **({} if untiled else {"halo": halo}))
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    spec = pl.BlockSpec((hb, w, cb, nb),
+                        lambda i, j, k: (i, 0, j, k), **kw)
+    lo_spec = pl.BlockSpec(
+        (hb, w, hblk, nb),
+        lambda i, j, k: (i, 0, jnp.maximum(j * (cb // hblk) - 1, 0), k),
+        **kw)
+    hi_spec = pl.BlockSpec(
+        (hb, w, hblk, nb),
+        lambda i, j, k: (i, 0, jnp.minimum((j + 1) * (cb // hblk),
+                                           c // hblk - 1), k),
+        **kw)
+    per_arg = [spec] if untiled else [spec, lo_spec, hi_spec]
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((h, w, c, n), out_dtype),
+        grid=(h // hb, nblk, n // nb),
+        in_specs=per_arg * len(args),
+        out_specs=spec,
+        interpret=interpret,
+    )(*[a for a in args for _ in range(len(per_arg))])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn_pallas_hwcn(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
+                    knorm: float) -> jnp.ndarray:
+    """LRN over logical NCHW via an (H, W, C, N)-layout Pallas kernel.
+
+    The wrapping transposes match XLA's physical activation layout, so
+    they lower to bitcasts rather than data movement (see module note).
+    """
+    out, _ = _lrn_hwcn_fwd_res(x, nsize, alpha, beta, knorm)
+    return out
+
+
+def _lrn_hwcn_fwd_res(x, nsize, alpha, beta, knorm):
+    xt = jnp.transpose(x, (2, 3, 1, 0))       # (H, W, C, N)
+    out = _lrn_hwcn_call(_lrn_hwcn_fwd_kernel, x.dtype, nsize,
+                         alpha / nsize, beta, knorm, (xt,),
+                         interpret=not _on_tpu())
+    return jnp.transpose(out, (3, 2, 0, 1)), x
+
+
+def _lrn_hwcn_bwd_res(nsize, alpha, beta, knorm, res, g):
+    x = res
+    xt = jnp.transpose(x, (2, 3, 1, 0))
+    gt = jnp.transpose(g, (2, 3, 1, 0))
+    dx = _lrn_hwcn_call(_lrn_hwcn_bwd_kernel, x.dtype, nsize,
+                        alpha / nsize, beta, knorm, (xt, gt),
+                        interpret=not _on_tpu())
+    return (jnp.transpose(dx, (3, 2, 0, 1)),)
+
+
+lrn_pallas_hwcn.defvjp(_lrn_hwcn_fwd_res, _lrn_hwcn_bwd_res)
+
+
+# --------------------------------------------------------------------------
+# Max pooling in the native (H, W, C, N) layout.  Same bitcast-boundary
+# trick as lrn_pallas_hwcn.  Forward: grid (C, N, OH) with k one-row input
+# refs per output row (index maps s*r+i — rows are blocks, so any stride
+# is plain indexing); the stride-s window along W uses the pad +
+# reshape-split phase form (mosaic_probe).  Backward implements mshadow's
+# exact all-ties unpool (``unpool<red::maximum>``: EVERY input equal to
+# its window max receives the window's gradient), which XLA's
+# select-and-scatter only approximates (one winner) — so this kernel is
+# both faster and closer to reference semantics.
+
+
+def _pool_phases(v, s, wpad, fill):
+    """(W, C, N) -> s phase views (wpad/s, C, N) along the major W axis."""
+    w, c, n = v.shape
+    if w < wpad:
+        pad = jnp.full((wpad - w, c, n), fill, v.dtype)
+        v = jnp.concatenate([v, pad], axis=0)
+    v2 = v.reshape(wpad // s, s, c, n)
+    return [v2[:, p] for p in range(s)]
+
+
+def _mp_hwcn_fwd_kernel(*refs, k, s, ow, wpad, h_in):
+    x_rows, o_ref = refs[:k], refs[k]
+    r = pl.program_id(2)
+    acc = None
+    for i in range(k):
+        row = x_rows[i][0].astype(jnp.float32)      # (W, C, NB)
+        # row i of the window is input row s*r+i; the index map clamps at
+        # the edge, so mask clamped reads (clipped tail windows) to -inf
+        valid = (s * r + i) < h_in
+        row = jnp.where(valid, row, NEG_INF)
+        ph = _pool_phases(row, s, wpad, NEG_INF)
+        for j in range(k):
+            v = ph[j % s][j // s:j // s + ow]
+            acc = v if acc is None else jnp.maximum(acc, v)
+    o_ref[0] = acc.astype(o_ref.dtype)
+
+
+def _mp_hwcn_bwd_kernel(*refs, k, s, ow, wpad, oh, h_in):
+    ncand = -(-k // s)  # output rows touching one input row
+    x_ref = refs[0]
+    p_refs = refs[1:1 + ncand]
+    dp_refs = refs[1 + ncand:1 + 2 * ncand]
+    dx_ref = refs[1 + 2 * ncand]
+    h = pl.program_id(2)
+    a = x_ref[0].astype(jnp.float32)                # (W, C, NB)
+    ph = _pool_phases(a, s, wpad, NEG_INF)
+    wq = wpad // s
+    r0 = (h - (k - 1) + (s - 1)) // s               # first candidate row
+    acc = [None] * s
+    for cand in range(ncand):
+        r = r0 + cand
+        pv = p_refs[cand][0].astype(jnp.float32)    # (OW, C, NB)
+        dv = dp_refs[cand][0].astype(jnp.float32)
+        # tap index i = h - s*r must lie in [0, k) and r in [0, oh)
+        i_tap = h - s * jnp.clip(r, 0, oh - 1)
+        valid_r = (r >= 0) & (r < oh) & (i_tap >= 0) & (i_tap < k)
+        dv = jnp.where(valid_r, dv, 0.0)
+        for j in range(k):
+            q = j // s
+            av = ph[j % s][q:q + ow]
+            contrib = jnp.where(av == pv, dv, 0.0)
+            parts = []
+            if q:
+                parts.append(jnp.zeros((q,) + contrib.shape[1:],
+                                       jnp.float32))
+            parts.append(contrib)
+            if wq - q - ow:
+                parts.append(jnp.zeros((wq - q - ow,) + contrib.shape[1:],
+                                       jnp.float32))
+            placed = parts[0] if len(parts) == 1 \
+                else jnp.concatenate(parts, axis=0)
+            acc[j % s] = placed if acc[j % s] is None \
+                else acc[j % s] + placed
+    zeros = jnp.zeros((wq,) + a.shape[1:], jnp.float32)
+    parts = [zeros if v is None else v for v in acc]
+    wide = jnp.stack(parts, axis=1).reshape((wpad,) + a.shape[1:])
+    dx_ref[0] = wide[:a.shape[0]].astype(dx_ref.dtype)
+
+
+def _mp_hwcn_fwd(xt, k, s, interpret):
+    h, w, c, n = xt.shape
+    oh = min(h - k + s - 1, h - 1) // s + 1
+    ow = min(w - k + s - 1, w - 1) // s + 1
+    wpad = -(-w // s) * s
+    nb = 128 if n % 128 == 0 else n
+    cb = c
+    while (w * cb * nb * 4) * (k + 2) > (10 << 20) and cb % 2 == 0:
+        cb //= 2
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+
+    x_specs = [
+        pl.BlockSpec((1, w, cb, nb),
+                     lambda bc, bn, r, i=i: (jnp.minimum(s * r + i, h - 1),
+                                             0, bc, bn), **kw)
+        for i in range(k)]
+    o_spec = pl.BlockSpec((1, ow, cb, nb),
+                          lambda bc, bn, r: (r, 0, bc, bn), **kw)
+    kern = functools.partial(_mp_hwcn_fwd_kernel, k=k, s=s, ow=ow,
+                             wpad=wpad, h_in=h)
+    return pl.pallas_call(
+        kern,
+        grid=(c // cb, n // nb, oh),
+        in_specs=x_specs,
+        out_specs=o_spec,
+        out_shape=jax.ShapeDtypeStruct((oh, ow, c, n), xt.dtype),
+        interpret=interpret,
+    )(*([xt] * k))
+
+
+def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret):
+    h, w, c, n = xt.shape
+    oh, ow = pt.shape[0], pt.shape[1]
+    wpad = -(-w // s) * s
+    ncand = -(-k // s)
+    nb = 128 if n % 128 == 0 else n
+    cb = c
+    while (w * cb * nb * 4) * (2 * ncand + 4) > (10 << 20) and cb % 2 == 0:
+        cb //= 2
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+
+    def cand_imap(cand):
+        def imap(bc, bn, hrow):
+            r0 = (hrow - (k - 1) + (s - 1)) // s
+            return (jnp.clip(r0 + cand, 0, oh - 1), 0, bc, bn)
+        return imap
+
+    x_spec = pl.BlockSpec((1, w, cb, nb),
+                          lambda bc, bn, hrow: (hrow, 0, bc, bn), **kw)
+    p_specs = [pl.BlockSpec((1, ow, cb, nb), cand_imap(i), **kw)
+               for i in range(ncand)]
+    kern = functools.partial(_mp_hwcn_bwd_kernel, k=k, s=s, ow=ow,
+                             wpad=wpad, oh=oh, h_in=h)
+    return pl.pallas_call(
+        kern,
+        grid=(c // cb, n // nb, h),
+        in_specs=[x_spec] + p_specs + p_specs,
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(xt.shape, xt.dtype),
+        interpret=interpret,
+    )(xt, *([pt] * ncand), *([dpt] * ncand))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def max_pool_hwcn(x: jnp.ndarray, k: int, s: int) -> jnp.ndarray:
+    """Max pool over logical NCHW via (H, W, C, N)-layout Pallas kernels
+    (no padding; reference tail-window rule).  Backward = exact mshadow
+    all-ties unpool."""
+    out, _ = _mp_fwd_res(x, k, s)
+    return out
+
+
+def _mp_fwd_res(x, k, s):
+    xt = jnp.transpose(x, (2, 3, 1, 0))
+    pt = _mp_hwcn_fwd(xt, k, s, interpret=not _on_tpu())
+    return jnp.transpose(pt, (3, 2, 0, 1)), (xt, pt)
+
+
+def _mp_bwd_res(k, s, res, g):
+    xt, pt = res
+    dpt = jnp.transpose(g, (2, 3, 1, 0))
+    dxt = _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret=not _on_tpu())
+    return (jnp.transpose(dxt, (3, 2, 0, 1)),)
+
+
+max_pool_hwcn.defvjp(_mp_fwd_res, _mp_bwd_res)
+
+
+# --------------------------------------------------------------------------
+# Strided-conv weight (+bias) gradient in the native layout.  The round-2
+# attempt im2col'd in VMEM per image and died on Mosaic's minor-dim
+# reshape limits; this formulation never reshapes: with activations
+# transposed to (H, W, C, N) (bitcast, see above), each (row, col)
+# position yields a lane-contraction dot
+#     acc[o, (tap, ci)] += dy[r, t, o, :] . xs2d[r+dh, t+dw, ci, :]
+# — (96, NB) x (448, NB) MXU calls accumulated across the whole grid
+# (rows innermost, so the single output block accumulates legally).
+# The bias gradient rides along as a lane-preserving row sum.
+
+
+def _cw_hwcn_kernel(dy_ref, x0_ref, x1_ref, x2_ref, dw_ref, db_ref, acc,
+                    accb, *, co, cin_b, kb, ow, taps_pad):
+    bn, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((bn == 0) & (r == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(r == 0)
+    def _():
+        accb[...] = jnp.zeros_like(accb)
+
+    dy_row = dy_ref[0]                       # (OW, co, NB) bf16
+    xs_rows = [x0_ref[0], x1_ref[0], x2_ref[0]][:kb]  # (WB, cin_b, NB)
+    a = acc[...]
+    for t in range(ow):
+        dy_rt = dy_row[t]                    # (co, NB)
+        cols = jnp.concatenate(
+            [xs_rows[dh][t + dw] for dh in range(kb) for dw in range(kb)]
+            + [jnp.zeros((taps_pad - kb * kb * cin_b, dy_rt.shape[1]),
+                         xs_rows[0].dtype)] * (taps_pad > kb * kb * cin_b),
+            axis=0)                          # (taps_pad, NB)
+        a = a + jax.lax.dot_general(
+            dy_rt, cols, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    acc[...] = a
+    accb[...] += jnp.sum(dy_row.astype(jnp.float32), axis=0)
+
+    @pl.when((bn == pl.num_programs(0) - 1) & (r == pl.num_programs(1) - 1))
+    def _():
+        dw_ref[...] = acc[...]
+
+    @pl.when(r == pl.num_programs(1) - 1)
+    def _():
+        db_ref[0] = accb[...]
+
+
+def conv_wgrad_hwcn_pallas(x: jnp.ndarray, dy: jnp.ndarray, *, kh: int,
+                           kw: int, stride: int, pad_y: int = 0,
+                           pad_x: int = 0, nb: int = 128,
+                           interpret: bool = None):
+    """Weight + bias gradient of a stride-s conv (no groups), logical
+    NCHW/OIHW, computed via the s2d identity in (H, W, C, N) layout.
+
+    Returns (dW (co, ci, kh, kw) f32, db (co,) f32).  For the
+    small-cin / large-stride geometry class (AlexNet conv1) where XLA's
+    dilated-dy wgrad starves the MXU.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    from .nn import s2d_input
+    n, c, h, w = x.shape
+    _, co, oh, ow = dy.shape
+    s = stride
+    xs2d, kb_y, kb_x = s2d_input(x, s, kh, kw, oh, ow, pad_y, pad_x)
+    assert kb_y == kb_x, "square kernels only"
+    kb = kb_y
+    assert kb <= 3, "kernel blocks up to 3 wired (extend x refs for more)"
+    cin_b = c * s * s
+    taps = kb * kb * cin_b
+    taps_pad = taps  # keep exact; MXU pads internally
+    xs_t = jnp.transpose(xs2d, (2, 3, 1, 0))     # (HB, WB, cin_b, N)
+    dy_t = jnp.transpose(dy, (2, 3, 1, 0))       # (OH, OW, co, N)
+    while n % nb:
+        nb //= 2
+    kw_ = {} if _VMEM is None else {"memory_space": _VMEM}
+    dy_spec = pl.BlockSpec((1, ow, co, nb),
+                           lambda bn, r: (r, 0, 0, bn), **kw_)
+    # rows r+i for i >= kb are never read; clamp their index maps
+    hb = xs_t.shape[0]
+    x_specs = [pl.BlockSpec((1, xs_t.shape[1], cin_b, nb),
+                            lambda bn, r, i=i: (jnp.minimum(r + i, hb - 1),
+                                                0, 0, bn), **kw_)
+               for i in range(3)]
+    dw_spec = pl.BlockSpec((co, taps_pad), lambda bn, r: (0, 0), **kw_)
+    db_spec = pl.BlockSpec((1, co, nb), lambda bn, r: (bn, 0, 0), **kw_)
+    kern = functools.partial(_cw_hwcn_kernel, co=co, cin_b=cin_b, kb=kb,
+                             ow=ow, taps_pad=taps_pad)
+    dw_inner, db_part = pl.pallas_call(
+        kern,
+        grid=(n // nb, oh),
+        in_specs=[dy_spec] + x_specs,
+        out_specs=[dw_spec, db_spec],
+        out_shape=[jax.ShapeDtypeStruct((co, taps_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((n // nb, co, nb), jnp.float32)],
+        scratch_shapes=_scratch((co, taps_pad), (co, nb)),
+        interpret=interpret,
+    )(dy_t, xs_t, xs_t, xs_t)
+    db = jnp.sum(db_part, axis=(0, 2))
+    # column order is (dh, dw) x (c, sy, sx) — invert to OIHW
+    dw6 = dw_inner.reshape(co, kb, kb, c, s, s)
+    dw6 = dw6.transpose(0, 3, 1, 4, 2, 5)        # (co, c, kb, sy, kb, sx)
+    dwp = dw6.reshape(co, c, kb * s, kb * s)
+    return dwp[:, :, :kh, :kw], db
 
 
 # --------------------------------------------------------------------------

@@ -72,74 +72,80 @@ def run_mnist():
                "test-error by round", errs)
 
 
-def _loss_curve(net_conf, batch, steps, nclass, shape, extra=()):
+def _loss_curve(net_conf, batch, steps, nclass, shape, extra=(),
+                nsamp=512, stop_below=None):
+    import jax
     import jax.numpy as jnp
     from __graft_entry__ import _make_trainer
     t = _make_trainer(net_conf, batch, "tpu",
                       extra=[("dtype", "bfloat16"), ("eval_train", "0"),
                              ("silent", "1"), *extra])
-    rnd = np.random.RandomState(0)
     # learnable synthetic data: per-class low-res spatial prototype
-    # (8x8 per channel, nearest-upsampled), centered, + noise.  The fixed
-    # k-step set is staged on device ONCE and re-dispatched (memorization
-    # curve) — the tunneled host->device link (~40 MB/s) cannot stream
-    # fresh ImageNet-sized batches, and a repeating-set loss curve
-    # demonstrates the optimizer path at full model scale just as well.
-    k = 10  # scan length per dispatch
-    protos = rnd.rand(nclass, shape[0], 8, 8).astype(np.float32)
-    ry, rx = -(-shape[1] // 8), -(-shape[2] // 8)
-    labels = rnd.randint(0, nclass, (k, batch))
-    pat = protos[labels].repeat(ry, axis=3).repeat(rx, axis=4)[
-        :, :, :, :shape[1], :shape[2]]
-    data = ((pat - 0.5) * 2
-            + rnd.rand(k, batch, *shape).astype(np.float32) * 0.25)
-    datas = jnp.asarray(data, jnp.bfloat16)
-    labs = jnp.asarray(labels[..., None], jnp.float32)
+    # (8x8 per channel, nearest-upsampled), centered, + noise - generated
+    # ON DEVICE (the tunneled host->device link cannot stream real
+    # ImageNet; memorizing a fixed small set exercises the full
+    # model/optimizer path, the reference's observable-convergence bar
+    # scaled to this environment).
+    assert nsamp % batch == 0
+    k = nsamp // batch
+    kd, kl = jax.random.split(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def gen(kd, kl):
+        labels = jax.random.randint(kl, (k, batch), 0, nclass)
+        protos = jax.random.uniform(kd, (nclass, shape[0], 8, 8))
+        ry, rx = -(-shape[1] // 8), -(-shape[2] // 8)
+        pat = jnp.repeat(jnp.repeat(protos[labels], ry, axis=3), rx,
+                         axis=4)[:, :, :, :shape[1], :shape[2]]
+        noise = jax.random.uniform(
+            jax.random.fold_in(kd, 1), (k, batch) + shape) * 0.25
+        return (((pat - 0.5) * 2 + noise).astype(jnp.bfloat16),
+                labels[..., None].astype(jnp.float32))
+
+    datas, labs = gen(kd, kl)
     curves = []
     for it in range(steps // k):
         losses = np.asarray(t.update_many(datas, labs))
         curves.extend(float(x) for x in losses)
+        if stop_below is not None and curves[-1] < stop_below:
+            break
     return curves
 
 
-# The reference's eta=0.01 is tuned for real-ImageNet statistics; the
-# synthetic constant-block prototypes carry far more energy per conv
-# window and diverge at that rate (measured: loss spikes to ~11 in the
-# first rounds, then collapses into a dead-relu state pinned at
-# ln(nclass)).  The curves are recorded at the stable 0.002.
-
-
 def run_imagenet():
+    # round-3 recipe (experiments/memorize.py): the flagship config at its
+    # OWN eta (0.01) memorizes a fixed 512-sample set from ln(1000)=6.9078
+    # to < 0.3 within ~500 steps - the end-to-end correctness evidence
+    # round 2 lacked (its 2560-sample/eta-0.004 curves sat near chance).
     from __graft_entry__ import ALEXNET_NET
-    curve = _loss_curve(
-        ALEXNET_NET.replace("eta = 0.01", "eta = 0.004"),
-        batch=256, steps=1600, nclass=1000, shape=(3, 227, 227))
+    curve = _loss_curve(ALEXNET_NET, batch=128, steps=3000, nclass=1000,
+                        shape=(3, 227, 227), stop_below=0.25)
+    marks = sorted(set([1, 100, 200, 300, 400, len(curve)]))
     record("imagenet-alexnet",
            "synthetic 1000-class (8x8 spatial prototypes + noise), fixed "
-           "2560-sample set, b256, eta 0.004, TPU v5e, bf16",
-           "softmax loss at steps [1, 400, 800, 1200, 1600]",
-           {s: round(curve[s - 1], 4)
-            for s in (1, 400, 800, 1200, 1600)})
-    # a clear, sustained descent below ln(1000)=6.9078 — NOT the dead-relu
-    # plateau pinned there (the init-inflated curve[0] alone would pass a
-    # relative check); best observed 6.8034, so gate just above it
-    assert curve[-1] < 6.81 and curve[-1] == min(
-        curve[s] for s in (0, 399, 799, 1199, 1599)), \
-        (curve[0], curve[-1])
+           "512-sample set, b128, eta 0.01 (flagship config), TPU v5e, "
+           "bf16 + f32 masters",
+           "softmax loss by step (memorization)",
+           {s: round(curve[s - 1], 4) for s in marks if s <= len(curve)})
+    assert curve[-1] < 0.5, ("AlexNet failed to memorize", curve[-1])
 
 
 def run_googlenet():
     from cxxnet_tpu.models import googlenet
     curve = _loss_curve(
         googlenet() + "metric = error\nrandom_type = xavier\n"
-        "eta = 0.002\nmomentum = 0.9\n",
-        batch=128, steps=600, nclass=1000, shape=(3, 224, 224))
+        "eta = 0.01\nmomentum = 0.9\n",
+        batch=128, steps=3000, nclass=1000, shape=(3, 224, 224),
+        stop_below=0.4)
+    marks = sorted(set([1, 200, 400, 800, 1200, len(curve)]))
     record("imagenet-googlenet",
            "synthetic 1000-class (8x8 spatial prototypes + noise), fixed "
-           "1280-sample set, b128, eta 0.002, TPU v5e, bf16",
-           "loss (main + 0.3*aux heads) at steps [1, 200, 400, 600]",
-           {s: round(curve[s - 1], 4) for s in (1, 200, 400, 600)})
-    assert curve[-1] < curve[1], (curve[0], curve[-1])
+           "512-sample set, b128, eta 0.01, TPU v5e, bf16",
+           "loss (main + 0.3*aux heads) by step (memorization)",
+           {s: round(curve[s - 1], 4) for s in marks if s <= len(curve)})
+    # the three heads bound the floor near 1.6x the main head; require a
+    # decisive collapse from chance (~9.2 with aux heads)
+    assert curve[-1] < 1.5, ("GoogLeNet failed to memorize", curve[-1])
 
 
 def run_dist():

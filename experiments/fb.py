@@ -15,16 +15,24 @@ import jax.numpy as jnp  # noqa: E402
 
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    model = sys.argv[2] if len(sys.argv) > 2 else "alexnet"
     scan_len, trials = 10, 2
     from __graft_entry__ import ALEXNET_NET, _make_trainer
     from bench import conv_flops_per_image, PEAK_FLOPS
-    t = _make_trainer(ALEXNET_NET, batch, "tpu",
+    if model == "googlenet":
+        from cxxnet_tpu.models import googlenet
+        conf = googlenet() + "metric = error\neta = 0.01\nmomentum = 0.9\n" \
+            "silent = 1\n"
+        shape = (3, 224, 224)
+    else:
+        conf, shape = ALEXNET_NET, (3, 227, 227)
+    t = _make_trainer(conf, batch, "tpu",
                       extra=[("dtype", "bfloat16"), ("eval_train", "0")])
     # generate on DEVICE: the tunneled host link (and one-core host rand)
     # must not gate a chip-compute measurement
     kd, kl = jax.random.split(jax.random.PRNGKey(0))
     datas = jax.jit(lambda k: jax.random.uniform(
-        k, (scan_len, batch, 3, 227, 227), jnp.float32
+        k, (scan_len, batch) + shape, jnp.float32
     ).astype(jnp.bfloat16))(kd)
     labels = jax.jit(lambda k: jax.random.randint(
         k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)

@@ -1,0 +1,146 @@
+"""Probe 2: Mosaic dot_general ranks + lane-merging reshapes (the forms
+the conv1-wgrad kernel design needs)."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def run(name, kern, out_shape, *args, dtype=jnp.float32):
+    try:
+        f = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct(out_shape, dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)
+                      for _ in args],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+        r = jax.jit(f)(*args)
+        r.block_until_ready()
+        print(f"{name:44s} OK   {r.shape}")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:100]
+        print(f"{name:44s} FAIL {msg}")
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (8, 4, 128), jnp.float32)
+
+    def k_merge_lane(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(8, 512)
+
+    run("reshape merge (4,128lane)->(512)", k_merge_lane, (8, 512), a)
+
+    def k_split_lane(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(8, 4, 128)
+
+    run("reshape split (512)->(4,128)", k_split_lane, (8, 4, 128),
+        jax.random.normal(key, (8, 512), jnp.float32))
+
+    b1 = jax.random.normal(key, (4, 64, 128), jnp.float32)
+    b2 = jax.random.normal(key, (4, 128, 64), jnp.float32)
+
+    def k_batched_dot(x_ref, y_ref, o_ref):
+        o_ref[...] = lax.dot_general(
+            x_ref[...], y_ref[...], (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    run("dot_general rank3 batched", k_batched_dot, (4, 64, 64), b1, b2)
+
+    c1 = jax.random.normal(key, (96, 3072), jnp.bfloat16)
+    c2 = jax.random.normal(key, (3072, 432), jnp.bfloat16)
+
+    def k_bigk(x_ref, y_ref, o_ref):
+        o_ref[...] = lax.dot_general(
+            x_ref[...], y_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    run("dot 2D (96,3072)@(3072,432) bf16", k_bigk, (96, 432), c1, c2)
+
+    # contraction over the LANE dim (outer-product accumulate form)
+    d1 = jax.random.normal(key, (96, 128), jnp.bfloat16)
+    d2 = jax.random.normal(key, (432, 128), jnp.bfloat16)
+
+    def k_lane_contract(x_ref, y_ref, o_ref):
+        o_ref[...] = lax.dot_general(
+            x_ref[...], y_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    run("dot 2D contract-lane (96,128)x(432,128)", k_lane_contract,
+        (96, 432), d1, d2)
+
+    # merge (55, 128) -> 7040 with non-pow2 sublane count
+    e = jax.random.normal(key, (8, 55, 128), jnp.float32)
+
+    def k_merge55(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(8, 55 * 128)
+
+    run("reshape merge (55,128lane)->(7040)", k_merge55, (8, 7040), e)
+
+    # 4D block row/col dynamic indexing + 2D extraction
+    f4 = jax.random.normal(key, (96, 8, 16, 128), jnp.bfloat16)
+
+    def k_4d_extract(x_ref, o_ref):
+        acc = jnp.zeros((96, 128), jnp.float32)
+        def body(i, acc):
+            return acc + x_ref[:, 2, i].astype(jnp.float32)
+        acc = lax.fori_loop(0, 16, body, acc)
+        o_ref[...] = acc
+
+    run("4D major dyn-index (96,128) extract", k_4d_extract, (96, 128),
+        f4)
+
+
+if __name__ == "__main__":
+    main()
+
+
+def extra():
+    key = jax.random.PRNGKey(1)
+    # strided slice on a MAJOR dim (dim 0 of a 3D block) — pool-over-W
+    # in (H, W, C, N) layout needs this
+    g = jax.random.normal(key, (55, 16, 128), jnp.bfloat16)
+
+    def k_major_stride(x_ref, o_ref):
+        o_ref[...] = lax.slice(x_ref[...], (0, 0, 0), (53, 16, 128),
+                               (2, 1, 1))
+
+    run("strided slice MAJOR dim (55,16,128)[::2]", k_major_stride,
+        (27, 16, 128), g, dtype=jnp.bfloat16)
+
+    def k_major_stride_jnp(x_ref, o_ref):
+        o_ref[...] = x_ref[...][0:53:2]
+
+    run("jnp [0:53:2] MAJOR dim", k_major_stride_jnp, (27, 16, 128), g,
+        dtype=jnp.bfloat16)
+
+    # sublane shifted slices on dim1 of rank-3 (LRN channel window form)
+    h = jax.random.normal(key, (8, 96, 128), jnp.bfloat16)
+
+    def k_sublane_shift(x_ref, o_ref):
+        v = x_ref[...]
+        o_ref[...] = v[:, 0:92] + v[:, 1:93] + v[:, 2:94]
+
+    run("sublane shifted sums (8,96,128)", k_sublane_shift, (8, 92, 128),
+        h, dtype=jnp.bfloat16)
+
+    # 4D: strided slice on dim0+dim1 of (55,55,16,128)
+    i4 = jax.random.normal(key, (55, 55, 16, 128), jnp.bfloat16)
+
+    def k_4d_stride(x_ref, o_ref):
+        v = x_ref[...]
+        o_ref[...] = v[0:53:2, 1:54:2]
+
+    run("4D strided both major dims", k_4d_stride, (27, 27, 16, 128), i4,
+        dtype=jnp.bfloat16)
+
+
+if __name__ == "__main__":
+    main()
+    extra()

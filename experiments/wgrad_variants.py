@@ -1,0 +1,290 @@
+"""Race three Pallas formulations of the conv1 wgrad on real geometry.
+
+All take (H, W, C, N)-layout rows and accumulate dW (96, 432):
+  A. loop55: one (96,nb)x(432,nb) lane-contraction dot per column
+     (the shipped conv_wgrad_hwcn_pallas inner loop — measured slow)
+  B. batchT: rank-3 batched dots over T-column chunks
+  C. bigK: in-kernel transpose rows to (C, W, nb), lane-merge to
+     (C, W*nb), one K=7040 dot per row
+
+Usage: python experiments/wgrad_variants.py
+"""
+import functools
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from experiments.mb_util import bench_op
+
+N_, CO, CB, OH, OW, KB = 1024, 96, 48, 55, 55, 3
+WB = OH - 1 + KB
+NB = 128
+TAPS = KB * KB * CB  # 432
+
+
+def specs():
+    kw = {"memory_space": pltpu.VMEM}
+    dy_spec = pl.BlockSpec((1, OW, CO, NB), lambda bn, r: (r, 0, 0, bn),
+                           **kw)
+    x_specs = [pl.BlockSpec((1, WB, CB, NB),
+                            lambda bn, r, i=i: (jnp.minimum(r + i, WB - 1),
+                                                0, 0, bn), **kw)
+               for i in range(KB)]
+    dw_spec = pl.BlockSpec((CO, TAPS), lambda bn, r: (0, 0), **kw)
+    return dy_spec, x_specs, dw_spec
+
+
+def call(kern, dy_t, xs_t):
+    dy_spec, x_specs, dw_spec = specs()
+    return pl.pallas_call(
+        kern,
+        grid=(N_ // NB, OH),
+        in_specs=[dy_spec] + x_specs,
+        out_specs=dw_spec,
+        out_shape=jax.ShapeDtypeStruct((CO, TAPS), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((CO, TAPS), jnp.float32)],
+    )(dy_t, xs_t, xs_t, xs_t)
+
+
+def k_loop55(dy_ref, x0, x1, x2, dw_ref, acc):
+    bn, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((bn == 0) & (r == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    dy_row = dy_ref[0]
+    xs = [x0[0], x1[0], x2[0]]
+    a = acc[...]
+    for t in range(OW):
+        cols = jnp.concatenate(
+            [xs[dh][t + dw] for dh in range(KB) for dw in range(KB)],
+            axis=0)
+        a = a + lax.dot_general(dy_row[t], cols, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    acc[...] = a
+
+    @pl.when((bn == pl.num_programs(0) - 1) & (r == pl.num_programs(1) - 1))
+    def _():
+        dw_ref[...] = acc[...]
+
+
+def k_batchT(dy_ref, x0, x1, x2, dw_ref, acc, *, T=11):
+    bn, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((bn == 0) & (r == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    dy_row = dy_ref[0]                       # (OW, CO, NB)
+    xs = [x0[0], x1[0], x2[0]]               # (WB, CB, NB)
+    a = acc[...]
+    for t0 in range(0, OW, T):
+        dyc = dy_row[t0:t0 + T]              # (T, CO, NB)
+        cols = jnp.concatenate(
+            [xs[dh][t0 + dw:t0 + dw + T]
+             for dh in range(KB) for dw in range(KB)], axis=1)
+        # (T, 432, NB); batched contract over lanes
+        part = lax.dot_general(dyc, cols, (((2,), (2,)), ((0,), (0,))),
+                               preferred_element_type=jnp.float32)
+        a = a + jnp.sum(part, axis=0)
+    acc[...] = a
+
+    @pl.when((bn == pl.num_programs(0) - 1) & (r == pl.num_programs(1) - 1))
+    def _():
+        dw_ref[...] = acc[...]
+
+
+def k_bigK(dy_ref, x0, x1, x2, dw_ref, acc):
+    bn, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((bn == 0) & (r == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    dy_row = dy_ref[0]                       # (OW, CO, NB)
+    dy2 = jnp.transpose(dy_row, (1, 0, 2)).reshape(CO, OW * NB)
+    xs = [x0[0], x1[0], x2[0]]
+    xt = [jnp.transpose(v, (1, 0, 2)) for v in xs]   # (CB, WB, NB)
+    cols = jnp.concatenate(
+        [xt[dh][:, dw:dw + OW].reshape(CB, OW * NB)
+         for dh in range(KB) for dw in range(KB)], axis=0)
+    acc[...] += lax.dot_general(dy2, cols, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+
+    @pl.when((bn == pl.num_programs(0) - 1) & (r == pl.num_programs(1) - 1))
+    def _():
+        dw_ref[...] = acc[...]
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    dy_t = jax.random.normal(key, (OH, OW, CO, N_), jnp.float32
+                             ).astype(jnp.bfloat16)
+    xs_t = jax.random.normal(jax.random.PRNGKey(1), (WB, WB, CB, N_),
+                             jnp.float32).astype(jnp.bfloat16)
+
+    ref = None
+    for name, kern in (("loop55", k_loop55),
+                       ("batchT11", functools.partial(k_batchT, T=11)),
+                       ("bigK", k_bigK)):
+        try:
+            f = jax.jit(lambda a, b, kern=kern: call(kern, a, b))
+            r = f(dy_t, xs_t)
+            r.block_until_ready()
+            if ref is None:
+                ref = np.asarray(r)
+            else:
+                err = np.abs(np.asarray(r) - ref).max() / (
+                    np.abs(ref).max() + 1e-9)
+                assert err < 2e-2, (name, err)
+            t = bench_op(lambda a, b, kern=kern: call(kern, a, b),
+                         dy_t, xs_t, k1=2, k2=10)
+            print(f"{name:10s} {t:7.3f} ms")
+        except Exception as e:
+            print(f"{name:10s} FAIL {str(e).splitlines()[0][:110]}")
+
+
+
+# bigK2: operands logically pre-transposed OUTSIDE the kernel to
+# (OH, CO, OW, N) / (HB, CB, WB, N) — XLA can satisfy these as layout
+# choices on the producer fusions — then ONE K=OW*NB dot per (row, block).
+def k_bigK2(dy_ref, x0, x1, x2, dw_ref, acc):
+    bn, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((bn == 0) & (r == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    dy2 = dy_ref[0].reshape(CO, OW * NB)          # lane-merge
+    xs = [x0[0], x1[0], x2[0]]                    # (CB, WB, NB)
+    cols = jnp.concatenate(
+        [xs[dh][:, dw:dw + OW].reshape(CB, OW * NB)
+         for dh in range(KB) for dw in range(KB)], axis=0)
+    acc[...] += lax.dot_general(dy2, cols, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+
+    @pl.when((bn == pl.num_programs(0) - 1) & (r == pl.num_programs(1) - 1))
+    def _():
+        dw_ref[...] = acc[...]
+
+
+def call2(kern, dy_t2, xs_t2):
+    kw = {"memory_space": pltpu.VMEM}
+    dy_spec = pl.BlockSpec((1, CO, OW, NB), lambda bn, r: (r, 0, 0, bn),
+                           **kw)
+    x_specs = [pl.BlockSpec((1, CB, WB, NB),
+                            lambda bn, r, i=i: (jnp.minimum(r + i, WB - 1),
+                                                0, 0, bn), **kw)
+               for i in range(KB)]
+    dw_spec = pl.BlockSpec((CO, TAPS), lambda bn, r: (0, 0), **kw)
+    return pl.pallas_call(
+        kern,
+        grid=(N_ // NB, OH),
+        in_specs=[dy_spec] + x_specs,
+        out_specs=dw_spec,
+        out_shape=jax.ShapeDtypeStruct((CO, TAPS), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((CO, TAPS), jnp.float32)],
+    )(dy_t2, xs_t2, xs_t2, xs_t2)
+
+
+def main2():
+    key = jax.random.PRNGKey(0)
+    dy_t = jax.random.normal(key, (OH, OW, CO, N_), jnp.float32
+                             ).astype(jnp.bfloat16)
+    xs_t = jax.random.normal(jax.random.PRNGKey(1), (WB, WB, CB, N_),
+                             jnp.float32).astype(jnp.bfloat16)
+
+    def run2(a, b):
+        # the logical transposes live INSIDE the benched fn so their cost
+        # (or absorption) is measured
+        return call2(k_bigK2, jnp.transpose(a, (0, 2, 1, 3)),
+                     jnp.transpose(b, (0, 2, 1, 3)))
+
+    r2 = jax.jit(run2)(dy_t, xs_t)
+    r1 = jax.jit(lambda a, b: call(k_loop55, a, b))(dy_t, xs_t)
+    err = np.abs(np.asarray(r2) - np.asarray(r1)).max() / (
+        np.abs(np.asarray(r1)).max() + 1e-9)
+    print("bigK2 rel err vs loop55:", err)
+    t = bench_op(run2, dy_t, xs_t, k1=2, k2=10)
+    print(f"bigK2 (incl transposes) {t:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
+    main2()
+
+
+# rowT: T output rows per program — xs row re-reads amortized
+# ((T+2)/T vs 3x) and 40 programs instead of 440.
+def k_rowT(dy_ref, xm_ref, xh1_ref, xh2_ref, dw_ref, acc, *, T):
+    bn, rb = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((bn == 0) & (rb == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    a = acc[...]
+    xm = xm_ref[...]          # (T, WB, CB, NB) rows rb*T .. rb*T+T-1
+    h1 = xh1_ref[0]           # row rb*T+T
+    h2 = xh2_ref[0]           # row rb*T+T+1
+    for tr in range(T):
+        dy_row = dy_ref[tr]
+        rows = [xm[tr + i] if tr + i < T else (h1 if tr + i == T else h2)
+                for i in range(KB)]
+        for t in range(OW):
+            cols = jnp.concatenate(
+                [rows[dh][t + dw] for dh in range(KB) for dw in range(KB)],
+                axis=0)
+            a = a + lax.dot_general(dy_row[t], cols,
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    acc[...] = a
+
+    @pl.when((bn == pl.num_programs(0) - 1) & (rb == pl.num_programs(1) - 1))
+    def _():
+        dw_ref[...] = acc[...]
+
+
+def main3(T=11):
+    kw = {"memory_space": pltpu.VMEM}
+    key = jax.random.PRNGKey(0)
+    dy_t = jax.random.normal(key, (OH, OW, CO, N_), jnp.float32
+                             ).astype(jnp.bfloat16)
+    xs_t = jax.random.normal(jax.random.PRNGKey(1), (WB, WB, CB, N_),
+                             jnp.float32).astype(jnp.bfloat16)
+    dy_spec = pl.BlockSpec((T, OW, CO, NB), lambda bn, rb: (rb, 0, 0, bn),
+                           **kw)
+    xm_spec = pl.BlockSpec((T, WB, CB, NB), lambda bn, rb: (rb, 0, 0, bn),
+                           **kw)
+    h_specs = [pl.BlockSpec(
+        (1, WB, CB, NB),
+        lambda bn, rb, i=i: (jnp.minimum(rb * T + T + i, WB - 1), 0, 0, bn),
+        **kw) for i in range(2)]
+    dw_spec = pl.BlockSpec((CO, TAPS), lambda bn, rb: (0, 0), **kw)
+
+    def run(a, b):
+        return pl.pallas_call(
+            functools.partial(k_rowT, T=T),
+            grid=(N_ // NB, OH // T),
+            in_specs=[dy_spec, xm_spec] + h_specs,
+            out_specs=dw_spec,
+            out_shape=jax.ShapeDtypeStruct((CO, TAPS), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((CO, TAPS), jnp.float32)],
+        )(a, b, b, b)
+
+    r = jax.jit(run)(dy_t, xs_t)
+    r1 = jax.jit(lambda a, b: call(k_loop55, a, b))(dy_t, xs_t)
+    err = np.abs(np.asarray(r) - np.asarray(r1)).max() / (
+        np.abs(np.asarray(r1)).max() + 1e-9)
+    print("rowT rel err:", err)
+    t = bench_op(run, dy_t, xs_t, k1=2, k2=10)
+    print(f"rowT{T:02d} {t:7.3f} ms")

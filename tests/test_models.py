@@ -52,7 +52,15 @@ def test_googlenet_builder_shapes():
     n_params = sum(int(np.prod(p.shape))
                    for g in net.init_params(jax.random.PRNGKey(0)).values()
                    for p in g.values())
-    assert 5_000_000 < n_params < 8_000_000  # ~7M (v1 single head)
+    # ~7M trunk + ~3.2M per aux head (fc1024 over 4x4x128) = ~13.4M
+    assert 12_000_000 < n_params < 15_000_000
+    # aux classifier heads present (v1 recipe), tapped at i4a and i4d
+    losses = [c for c in net.connections if c.layer.is_loss]
+    assert len(losses) == 3
+    # single-head variant still available
+    net1 = build(googlenet(aux_heads=False))
+    losses1 = [c for c in net1.connections if c.layer.is_loss]
+    assert len(losses1) == 1
 
 
 def test_tiny_googlenet_trains():

@@ -258,3 +258,70 @@ def test_flash_attention_asymmetric_blocks():
     finally:
         ring.CHUNKED_ATTN_THRESHOLD = old
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_lrn_hwcn_matches_xla():
+    """Native-layout (H,W,C,N) LRN kernel == XLA path, fwd + grad."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops import nn as N
+    from cxxnet_tpu.ops.pallas_kernels import lrn_pallas_hwcn
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 96, 9, 9),
+                    jnp.float32)
+    a = lrn_pallas_hwcn(x, 5, 0.001, 0.75, 1.0)
+    b = N.lrn(x, 5, 0.001, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=1e-6)
+    ga = jax.grad(lambda v: (lrn_pallas_hwcn(v, 5, .001, .75, 1.) ** 2
+                             ).sum())(x)
+    gb = jax.grad(lambda v: (N.lrn(v, 5, .001, .75, 1.) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                               rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape,k,s", [
+    ((4, 16, 27, 27), 3, 2),   # AlexNet pool2 family
+    ((2, 8, 13, 13), 3, 2),    # clipped tail
+    ((2, 8, 12, 12), 2, 2),    # VGG/LeNet family
+    ((2, 8, 9, 9), 3, 1),      # inception same-size branch (no pad)
+])
+def test_max_pool_hwcn_matches_eq(shape, k, s):
+    """Native-layout pool kernel == reference rule fwd; backward == exact
+    all-ties eq-mask unpool (mshadow semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops import nn as N
+    from cxxnet_tpu.ops.pallas_kernels import max_pool_hwcn
+    x = jnp.asarray(np.random.RandomState(1).randn(*shape), jnp.float32)
+    a = max_pool_hwcn(x, k, s)
+    b = N._max_pool_raw(x, k, k, s, 0, 0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    g = jnp.asarray(np.random.RandomState(2).randn(*a.shape), jnp.float32)
+    da = jax.vjp(lambda v: max_pool_hwcn(v, k, s), x)[1](g)[0]
+    db = jax.vjp(lambda v: N._max_pool_eq(v, k, k, s, 0, 0), x)[1](g)[0]
+    np.testing.assert_allclose(np.asarray(da), np.asarray(db), atol=1e-4)
+
+
+@pytest.mark.parametrize("geom", [
+    (8, 3, 23, 23, 16, 11, 4),   # AlexNet conv1 class (kb=3)
+    (4, 3, 18, 18, 8, 5, 2),     # 5x5/s2 class (kb=3)
+])
+def test_conv_wgrad_hwcn_matches_xla(geom):
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops import nn as N
+    from cxxnet_tpu.ops.pallas_kernels import conv_wgrad_hwcn_pallas
+    n, c, h, w_, co, k, s = geom
+    rnd = np.random.RandomState(3)
+    x = jnp.asarray(rnd.randn(n, c, h, w_), jnp.float32)
+    wt = jnp.asarray(rnd.randn(co, c, k, k) * 0.1, jnp.float32)
+    oh = (h - k) // s + 1
+    dy = jnp.asarray(rnd.randn(n, co, oh, oh), jnp.float32)
+    _, vjp = jax.vjp(lambda wv: N.conv2d(x, wv, stride=s), wt)
+    (dw_ref,) = vjp(dy)
+    dw, db = conv_wgrad_hwcn_pallas(x, dy, kh=k, kw=k, stride=s)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db),
+                               np.asarray(dy.sum(axis=(0, 2, 3))),
+                               rtol=1e-5, atol=1e-5)
