@@ -73,6 +73,22 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
     operands on TPU; an explicit preferred_element_type would break the
     conv transpose/grad rule's same-dtype requirement).
     """
+    if num_group > 1 and _GROUP_CONV == "split":
+        # A/B probe: grouped conv as per-group convs + concat (XLA's
+        # feature_group_count dgrad measured 2.9 ms vs ~1.2 roofline on
+        # AlexNet conv2; separate convs give XLA independent layouts)
+        cg = x.shape[1] // num_group
+        og = w.shape[0] // num_group
+        outs = [
+            lax.conv_general_dilated(
+                lax.slice_in_dim(x, g * cg, (g + 1) * cg, axis=1),
+                lax.slice_in_dim(w.astype(x.dtype), g * og, (g + 1) * og,
+                                 axis=0),
+                window_strides=(stride, stride),
+                padding=((pad_y, pad_y), (pad_x, pad_x)),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            for g in range(num_group)]
+        return jnp.concatenate(outs, axis=1)
     return lax.conv_general_dilated(
         x, w.astype(x.dtype),
         window_strides=(stride, stride),
@@ -149,6 +165,18 @@ def use_fast_wgrad(cin: int, stride: int, num_group: int) -> bool:
             and cin <= 4 and jax.default_backend() == "tpu")
 
 
+# grouped-conv lowering: "fgc" (default) XLA feature_group_count;
+# "split" lowers each group as its own conv + concat (A/B probe for the
+# grouped dgrad cost)
+_GROUP_CONV = os.environ.get("CXXNET_GROUP_CONV", "fgc")
+
+
+# forward lowering for the fast-wgrad conv class: "conv" (default) XLA
+# strided conv; "s2d" routes the forward through the space-to-depth
+# identity too (A/B probe; round-2 measured it slower on v5e)
+_FAST_CONV_FWD = os.environ.get("CXXNET_CONV1_FWD", "conv")
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def conv_bias_fast(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
                    stride: int, pad_y: int, pad_x: int) -> jnp.ndarray:
@@ -159,7 +187,10 @@ def conv_bias_fast(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
     and dx through XLA's transposed conv — which XLA dead-code-eliminates
     when the conv sits on the data layer, the AlexNet conv1 case.
     """
-    out = conv2d(x, w, stride=stride, pad_y=pad_y, pad_x=pad_x)
+    if _FAST_CONV_FWD == "s2d":
+        out = conv2d_s2d(x, w, stride=stride, pad_y=pad_y, pad_x=pad_x)
+    else:
+        out = conv2d(x, w, stride=stride, pad_y=pad_y, pad_x=pad_x)
     return out + b.astype(out.dtype).reshape(1, -1, 1, 1)
 
 
