@@ -8,7 +8,7 @@ graph and adding the elementwise/pool HBM traffic at peak bandwidth
 yields the best step time ANY schedule could reach — the honest ceiling
 to compare measured MFU against.
 
-Usage: python experiments/googlenet_roofline.py [googlenet|alexnet] [batch]
+Usage: python experiments/googlenet_roofline.py [googlenet|alexnet|resnetN] [batch]
 """
 import sys
 
@@ -32,9 +32,14 @@ def analyze(which="googlenet", batch=256):
     from cxxnet_tpu.utils.config import parse_config_string
     from cxxnet_tpu.layers.conv import ConvolutionLayer, _PoolingBase
     from cxxnet_tpu.layers.fullc import FullConnectLayer
-    from cxxnet_tpu.models import googlenet, alexnet
+    from cxxnet_tpu.models import googlenet, alexnet, resnet
 
-    conf = googlenet() if which == "googlenet" else alexnet()
+    if which == "googlenet":
+        conf = googlenet()
+    elif which.startswith("resnet"):
+        conf = resnet(num_class=10, depth=int(which[6:]))
+    else:
+        conf = alexnet()
     cfg = NetConfig()
     cfg.configure(parse_config_string(conf))
     net = Network(cfg, batch)

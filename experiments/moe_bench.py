@@ -1,0 +1,61 @@
+"""Dense vs sorted MoE dispatch: step time + peak memory at scale.
+
+VERDICT r3 #9 acceptance: a measured win at t >= 8k, e >= 16.
+Usage: python experiments/moe_bench.py [tokens] [experts] [dim] [hidden]
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cxxnet_tpu.layers.base import ForwardContext
+from cxxnet_tpu.layers.registry import create_layer
+from experiments.mb_util import bench_op
+
+
+def make(dispatch, e, h, cf=1.25):
+    l = create_layer("moe")
+    l.set_param("num_expert", str(e))
+    l.set_param("nhidden", str(h))
+    l.set_param("capacity_factor", str(cf))
+    l.set_param("moe_dispatch", dispatch)
+    l.set_param("init_sigma", "0.05")
+    return l
+
+
+def main():
+    t = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    e = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    d = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+    h = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
+    b, s = 8, t // 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, 1, s, d),
+                          jnp.float32).astype(jnp.bfloat16)
+
+    for dispatch in ("dense", "sorted"):
+        layer = make(dispatch, e, h)
+        layer.infer_shapes([(b, 1, s, d)])
+        params = layer.init_params(jax.random.PRNGKey(1), [(b, 1, s, d)],
+                                   jnp.bfloat16)
+
+        def step(p, xx):
+            def loss(p):
+                ctx = ForwardContext(train=True, loss_scale=1.0 / b)
+                (out,), _ = layer.forward(p, {}, [xx], ctx)
+                return (out.astype(jnp.float32) ** 2).sum() + ctx.losses[0]
+            l, g = jax.value_and_grad(loss)(p)
+            return l, g
+
+        compiled = jax.jit(step).lower(params, x).compile()
+        mem = compiled.memory_analysis()
+        peak = getattr(mem, "temp_size_in_bytes", 0)
+        ms = bench_op(step, params, x, k1=2, k2=10)
+        print(f"{dispatch:6s} t={t} e={e} cap={layer._capacity(t)}: "
+              f"{ms:7.2f} ms/step  temp {peak/1e6:7.1f} MB")
+
+
+if __name__ == "__main__":
+    main()
