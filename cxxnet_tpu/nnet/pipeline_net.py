@@ -60,20 +60,21 @@ def partition_network(net, n_stage: int) -> Tuple[List[Tuple[int, int]], int]:
         if c.layer.is_loss:
             body_end = i
             break
-    assert body_end > 0, "pipeline: network has no non-loss body"
+    assert body_end > 0, "graph partition: network has no non-loss body"
     non_loss_after = [i for i in range(body_end, len(conns))
                       if not conns[i].layer.is_loss]
     assert not non_loss_after, (
-        "pipeline: loss layers must all trail the network body — "
-        "mid-graph auxiliary heads (e.g. googlenet(aux_heads=True)) are "
-        "not partitionable; use aux_heads=False for pipeline runs")
+        "graph partition (pipe/remat): loss layers must all trail the "
+        "network body — mid-graph auxiliary heads (e.g. "
+        "googlenet(aux_heads=True)) are not partitionable; use "
+        "aux_heads=False with mesh=pipe / remat")
     for c in conns[:body_end]:
         nb = c.layer.init_buffers(
             [net.node_shapes[n] for n in c.nindex_in])
         assert not nb, (
-            f"pipeline: layer {c.layer.type_names[0]} keeps running "
-            "buffers (e.g. batch_norm moving stats); buffer updates don't "
-            "thread through the pipeline schedule yet")
+            f"graph partition (pipe/remat): layer {c.layer.type_names[0]} "
+            "keeps running buffers (e.g. batch_norm moving stats); buffer "
+            "updates don't thread through partitioned execution yet")
 
     # consumers per node over the body + the boundary into the loss tail
     last_use = {}
@@ -106,8 +107,9 @@ def partition_network(net, n_stage: int) -> Tuple[List[Tuple[int, int]], int]:
     for k in range(1, n_stage):
         target = total * k / n_stage
         assert avail, (
-            f"pipeline: graph has too few single-node cut points for "
-            f"pipe:{n_stage} (found {len(legal)} legal cuts)")
+            f"graph partition (pipe/remat): too few single-node cut "
+            f"points for {n_stage} segments (found {len(legal)} legal "
+            "cuts)")
         best = min(avail, key=lambda i: abs(prefix[i] - target))
         cuts.append(best)
         avail = [i for i in avail if i > best]
@@ -132,7 +134,8 @@ def _boundary_node(net, end: int, body_end: int) -> int:
 
 
 def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
-                   loss_scale: float, rng=None) -> List[Callable]:
+                   loss_scale: float, rng=None,
+                   mesh=None) -> List[Callable]:
     """Build ``stage_fns[s](params, value, m)`` callables for
     :func:`pipeline_apply_hetero`.
 
@@ -152,7 +155,7 @@ def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
                 train=train,
                 rng=None if rng is None
                 else jax.random.fold_in(rng, m * n_stage + s),
-                epoch=epoch, loss_scale=loss_scale)
+                epoch=epoch, loss_scale=loss_scale, mesh=mesh)
             nodes = {in_nodes[s]: value}
             for j in range(s0, s1):
                 conn = net.connections[j]

@@ -490,3 +490,72 @@ def test_native_jpeg_u8_records(tmp_path):
     for b8, bf in zip(b8s, bfs):
         assert b8.data.dtype == np.uint8
         np.testing.assert_array_equal(b8.data.astype(np.float32), bf.data)
+
+
+def test_cli_train_e2e_on_u8_native_pipeline(tmp_path):
+    """Full CLI train over the native loader in u8 mode: raw u8 records
+    stream through C++ untouched, the trainer normalizes on device, and
+    a linearly-separable task trains to zero error — the whole
+    output_u8 path exercised at the task-driver level."""
+    from cxxnet_tpu.main import LearnTask
+
+    rnd = np.random.RandomState(0)
+    n, c, h, w = 96, 1, 8, 8
+    bin_p = str(tmp_path / "u8.bin")
+    lst_p = str(tmp_path / "u8.lst")
+    wtr = BinaryPageWriter(bin_p, page_size=1 << 12)
+    with open(lst_p, "w") as lf:
+        for i in range(n):
+            label = i % 2
+            img = rnd.randint(0, 60, (c, h, w)).astype(np.uint8)
+            if label:
+                img[:, :4] = np.minimum(img[:, :4] + 150, 255)
+            wtr.push(img.tobytes())
+            lf.write(f"{i}\t{float(label)}\tu{i}.bin\n")
+    wtr.close()
+    conf = tmp_path / "u8.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = imbin_native
+  path_imgbin = {bin_p}
+  path_imglst = {lst_p}
+  output_u8 = 1
+  decode_thread_num = 0
+iter = end
+eval = val
+iter = imbin_native
+  path_imgbin = {bin_p}
+  path_imglst = {lst_p}
+  output_u8 = 1
+  decode_thread_num = 0
+iter = end
+netconfig=start
+layer[+1] = flatten
+layer[+1] = fullc:fc
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = {c},{h},{w}
+mean_value = 64
+scale = 0.01
+batch_size = 16
+eta = 0.5
+num_round = 8
+metric = error
+model_dir = {tmp_path}/models
+save_model = 0
+silent = 1
+""")
+    import io as _io
+    import contextlib
+    import re
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        assert LearnTask().run([str(conf)]) == 0
+    lines = [ln for ln in err.getvalue().splitlines() if "val-error" in ln]
+    assert lines, err.getvalue()[-500:]
+    final_err = float(re.search(r"val-error:([0-9.eE+-]+)",
+                                lines[-1]).group(1))
+    assert final_err == 0.0, lines[-3:]

@@ -89,3 +89,22 @@ def test_pipelined_eval_matches():
     pred_ref = ref.predict(batches[0])
     pred_pp = pp.predict(batches[0])
     np.testing.assert_array_equal(pred_ref, pred_pp)
+
+
+def test_remat_matches_plain_trajectory():
+    """remat = K recomputes activations in backward; the math is
+    unchanged, so a dropout-free net's trajectory matches exactly."""
+    batches = _batches(4)
+    ref = make_trainer(_lenet_conf(), extra=EXTRA + [("dev", "cpu")])
+    rm = make_trainer(_lenet_conf(),
+                      extra=EXTRA + [("dev", "cpu"), ("remat", "3")])
+    for b in batches:
+        ref.update(b)
+        rm.update(b)
+        np.testing.assert_array_equal(np.asarray(rm._last_loss),
+                                      np.asarray(ref._last_loss))
+    for pkey, group in ref.params.items():
+        for tag, v in group.items():
+            np.testing.assert_array_equal(
+                np.asarray(rm.params[pkey][tag]), np.asarray(v),
+                err_msg=f"{pkey}/{tag}")
