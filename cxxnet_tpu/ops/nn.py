@@ -35,17 +35,16 @@ _PALLAS_LRN = os.environ.get("CXXNET_PALLAS_LRN", "hwcn")
 
 
 def _lrn_hwcn_fits(shape) -> bool:
-    # empirical win region (v5e): small-spatial LRN planes (AlexNet 27x27,
-    # 13x13: -2.5 ms/step) take the kernel; large-spatial planes
-    # (GoogLeNet 56x56: hb=1 single-row blocks, measured slower than XLA)
-    # stay on the XLA path.  Batches must fill the 128-lane tile: Mosaic
-    # pads the minor dim to 128 regardless of n, so a small-batch block
-    # would be 128/n times larger than the estimate (measured VMEM OOM at
-    # n=2) — and the layout-match argument only holds for lane-full
-    # batches anyway.
+    # empirical win region (v5e): AlexNet's 27x27/13x13 planes win
+    # -2.5 ms/step, GoogLeNet's 56x56 planes -4 ms/step (the halo-free
+    # untiled kernel; the earlier halo-assembly variant OOM'd VMEM there).
+    # Batches must fill the 128-lane tile: Mosaic pads the minor dim to
+    # 128 regardless of n, so a small-batch block would be 128/n times
+    # larger than the estimate (measured VMEM OOM at n=2) — and the
+    # layout-match argument only holds for lane-full batches anyway.
     n, c, h, w = shape
     return (jax.default_backend() == "tpu" and n % 128 == 0
-            and w <= 32 and w * c * 128 * 4 <= (3 << 20))
+            and w <= 64 and w * c * 128 * 4 <= (3 << 20))
 
 
 def pool_out_size(in_size: int, ksize: int, stride: int) -> int:
