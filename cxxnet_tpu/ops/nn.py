@@ -384,12 +384,21 @@ def _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x):
 
 def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
                pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
-    if (_POOL_LAYOUT == "hwcn" and pad_y == 0 and pad_x == 0
-            and ksize_y == ksize_x):
+    hwcn_ok = (pad_y == 0 and pad_x == 0 and ksize_y == ksize_x
+               and jax.default_backend() == "tpu" and x.shape[0] % 128 == 0)
+    want_allties = _POOL_LAYOUT == "hwcn" or _POOL_BWD in ("eq", "gather")
+    if want_allties and hwcn_ok:
         # Pallas kernels in XLA's native (H, W, C, N) activation layout:
-        # bitcast boundary, exact mshadow all-ties backward
+        # exact mshadow all-ties backward, ~15x faster than the XLA
+        # dilate-and-add eq formulation (6 vs 96 ms standalone on AlexNet
+        # pool1 b1024; still slower than SAS, so an exactness opt-in)
         from .pallas_kernels import max_pool_hwcn
         return max_pool_hwcn(x, ksize_y, stride)
+    if _POOL_LAYOUT == "hwcn" and not hwcn_ok:
+        # keep all-ties semantics for the shapes the kernel can't take
+        # (padded pools, partial batches, CPU) — gradient semantics must
+        # not flip with batch divisibility mid-run
+        return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     if _POOL_LAYOUT == "chwn" and _POOL_BWD == "sas":
         xt = jnp.transpose(x, (1, 2, 3, 0))
         # reuse the NCHW padding/window logic by viewing (C, H, W, N) as

@@ -425,6 +425,34 @@ def _mp_hwcn_fwd_kernel(*refs, k, s, ow, wpad, h_in):
     o_ref[0] = acc.astype(o_ref.dtype)
 
 
+def _mp_col_place(ph, pv, dv, k, s, ow, wq, acc):
+    """Accumulate one candidate row's column taps into the per-phase
+    accumulators (shared by the 1-row and multi-row backward kernels)."""
+    for j in range(k):
+        q = j // s
+        av = ph[j % s][q:q + ow]
+        contrib = jnp.where(av == pv, dv, 0.0)
+        parts = []
+        if q:
+            parts.append(jnp.zeros((q,) + contrib.shape[1:], jnp.float32))
+        parts.append(contrib)
+        if wq - q - ow:
+            parts.append(jnp.zeros((wq - q - ow,) + contrib.shape[1:],
+                                   jnp.float32))
+        placed = parts[0] if len(parts) == 1 \
+            else jnp.concatenate(parts, axis=0)
+        acc[j % s] = placed if acc[j % s] is None \
+            else acc[j % s] + placed
+    return acc
+
+
+def _mp_interleave(acc, a_row, wpad, wq):
+    zeros = jnp.zeros((wq,) + a_row.shape[1:], jnp.float32)
+    parts = [zeros if v is None else v for v in acc]
+    wide = jnp.stack(parts, axis=1).reshape((wpad,) + a_row.shape[1:])
+    return wide[:a_row.shape[0]]
+
+
 def _mp_hwcn_bwd_kernel(*refs, k, s, ow, wpad, oh, h_in):
     ncand = -(-k // s)  # output rows touching one input row
     x_ref = refs[0]
@@ -445,26 +473,44 @@ def _mp_hwcn_bwd_kernel(*refs, k, s, ow, wpad, oh, h_in):
         i_tap = h - s * jnp.clip(r, 0, oh - 1)
         valid_r = (r >= 0) & (r < oh) & (i_tap >= 0) & (i_tap < k)
         dv = jnp.where(valid_r, dv, 0.0)
-        for j in range(k):
-            q = j // s
-            av = ph[j % s][q:q + ow]
-            contrib = jnp.where(av == pv, dv, 0.0)
-            parts = []
-            if q:
-                parts.append(jnp.zeros((q,) + contrib.shape[1:],
-                                       jnp.float32))
-            parts.append(contrib)
-            if wq - q - ow:
-                parts.append(jnp.zeros((wq - q - ow,) + contrib.shape[1:],
-                                       jnp.float32))
-            placed = parts[0] if len(parts) == 1 \
-                else jnp.concatenate(parts, axis=0)
-            acc[j % s] = placed if acc[j % s] is None \
-                else acc[j % s] + placed
-    zeros = jnp.zeros((wq,) + a.shape[1:], jnp.float32)
-    parts = [zeros if v is None else v for v in acc]
-    wide = jnp.stack(parts, axis=1).reshape((wpad,) + a.shape[1:])
-    dx_ref[0] = wide[:a.shape[0]].astype(dx_ref.dtype)
+        acc = _mp_col_place(ph, pv, dv, k, s, ow, wq, acc)
+    dx_ref[0] = _mp_interleave(acc, a, wpad, wq).astype(dx_ref.dtype)
+
+
+def _mp_hwcn_bwd_kernel_mr(*refs, k, s, ow, wpad, oh, h_in, hb, nref):
+    """Multi-row backward: hb input rows per program (hb % s == 0, so the
+    candidate-row offsets are static per in-block row), p/dp supplied as
+    ``nref`` one-row refs starting at the block's first candidate row."""
+    ncand = -(-k // s)
+    x_ref = refs[0]
+    p_refs = refs[1:1 + nref]
+    dp_refs = refs[1 + nref:1 + 2 * nref]
+    dx_ref = refs[1 + 2 * nref]
+    bh = pl.program_id(2)
+    h0 = bh * hb
+    rbase = (h0 - (k - 1) + (s - 1)) // s
+    wq = wpad // s
+    rel0 = (-(k - 1) + (s - 1)) // s  # rel_j at j=0 (s | h0)
+    rows = []
+    for j in range(hb):
+        a = x_ref[j].astype(jnp.float32)            # (W, C, NB)
+        ph = _pool_phases(a, s, wpad, NEG_INF)
+        rel_j = (j - (k - 1) + (s - 1)) // s - rel0
+        acc = [None] * s
+        for cand in range(ncand):
+            # absolute candidate row and its static tap index
+            i_tap = j - s * ((j - (k - 1) + (s - 1)) // s) - s * cand
+            if i_tap < 0 or i_tap >= k:
+                continue
+            ref_i = rel_j + cand
+            r_abs = rbase + ref_i
+            pv = p_refs[ref_i][0].astype(jnp.float32)
+            dv = dp_refs[ref_i][0].astype(jnp.float32)
+            valid = (r_abs >= 0) & (r_abs < oh) & (h0 + j < h_in)
+            dv = jnp.where(valid, dv, 0.0)
+            acc = _mp_col_place(ph, pv, dv, k, s, ow, wq, acc)
+        rows.append(_mp_interleave(acc, a, wpad, wq))
+    dx_ref[...] = jnp.stack(rows, axis=0).astype(dx_ref.dtype)
 
 
 def _mp_hwcn_fwd(xt, k, s, interpret):
@@ -497,16 +543,55 @@ def _mp_hwcn_fwd(xt, k, s, interpret):
     )(*([xt] * k))
 
 
-def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret):
+def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
     h, w, c, n = xt.shape
     oh, ow = pt.shape[0], pt.shape[1]
     wpad = -(-w // s) * s
     ncand = -(-k // s)
     nb = 128 if n % 128 == 0 else n
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    if hb is None:
+        hb = 3 * s  # multi-row default: amortizes per-program overhead
+    if hb > 1:
+        # multi-row blocks need s | hb (static candidate offsets)
+        hb = max(hb - hb % s, s)
+        rel0 = (-(k - 1) + (s - 1)) // s
+        rel_last = (hb - 1 - (k - 1) + (s - 1)) // s - rel0
+        nref = rel_last + ncand
+        # dominant VMEM per (w, cb, nb) plane: in/out blocks + the f32
+        # row accumulators and their stack (~12 block-planes per row);
+        # floor at one sublane tile (16) — this exact formula is the
+        # measured-working configuration (52.8 ms AlexNet eq step)
+        cb = c
+        while w * cb * nb * 12 * hb > (14 << 20) and cb % 2 == 0 \
+                and cb > 16:
+            cb //= 2
+
+        def p_imap(i):
+            def imap(bc, bn, bh):
+                rbase = (bh * hb - (k - 1) + (s - 1)) // s
+                return (jnp.clip(rbase + i, 0, oh - 1), 0, bc, bn)
+            return imap
+
+        x_spec = pl.BlockSpec((hb, w, cb, nb),
+                              lambda bc, bn, bh: (bh, 0, bc, bn), **kw)
+        p_specs = [pl.BlockSpec((1, ow, cb, nb), p_imap(i), **kw)
+                   for i in range(nref)]
+        kern = functools.partial(_mp_hwcn_bwd_kernel_mr, k=k, s=s, ow=ow,
+                                 wpad=wpad, oh=oh, h_in=h, hb=hb,
+                                 nref=nref)
+        return pl.pallas_call(
+            kern,
+            grid=(c // cb, n // nb, -(-h // hb)),
+            in_specs=[x_spec] + p_specs + p_specs,
+            out_specs=x_spec,
+            out_shape=jax.ShapeDtypeStruct(xt.shape, xt.dtype),
+            interpret=interpret,
+        )(xt, *([pt] * nref), *([dpt] * nref))
+
     cb = c
     while (w * cb * nb * 4) * (2 * ncand + 4) > (10 << 20) and cb % 2 == 0:
         cb //= 2
-    kw = {} if _VMEM is None else {"memory_space": _VMEM}
 
     def cand_imap(cand):
         def imap(bc, bn, hrow):
