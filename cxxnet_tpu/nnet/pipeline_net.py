@@ -6,7 +6,9 @@ conv net), balances stages by a FLOP estimate, and runs the body through
 :func:`cxxnet_tpu.parallel.pipeline.pipeline_apply_hetero` with microbatches
 drawn from the batch dim.  The trailing loss layers (self-loops, reference
 ``loss/loss_layer_base-inl.hpp:36``) run outside the pipeline on the
-collected outputs, so ``ctx.losses``/label plumbing is unchanged.
+collected outputs with the full label plumbing; mid-body ``ctx.losses``
+contributions (and the tail-batch loss mask they consult) are threaded
+through the stage boundaries — see :func:`make_stage_fns`.
 
 No reference counterpart — the reference's only scaling axis is data
 parallelism through mshadow-ps (SURVEY.md §2.8); ``mesh = pipe:K`` extends
@@ -19,7 +21,7 @@ from typing import Callable, List, Tuple
 
 import jax.numpy as jnp
 
-from ..layers.base import ForwardContext
+from ..layers.base import ForwardContext, LabelInfo
 from ..layers.conv import ConvolutionLayer
 from ..layers.fullc import FullConnectLayer
 
@@ -139,6 +141,15 @@ def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
     """Build ``stage_fns[s](params, value, m)`` callables for
     :func:`pipeline_apply_hetero`.
 
+    ``value`` is an ``(activation, aux_loss)`` pair — or an
+    ``(activation, aux_loss, mask)`` triple on masked tail batches: mid-
+    body layers that append to ``ctx.losses`` (the MoE Switch load-balance
+    aux loss being the concrete case) must survive partitioned execution,
+    so each stage folds its ``ctx.losses`` into the accumulator that rides
+    along with the boundary activation, and the tail-batch loss mask rides
+    along too so those layers exclude replica instances from their
+    statistics exactly like the plain path.
+
     Each stage runs its connection range over a local node environment;
     randomness is keyed per (microbatch, stage) so dropout etc. stay
     deterministic under any pipe width.
@@ -151,12 +162,16 @@ def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
 
     def mk(s, s0, s1):
         def fn(params, value, m):
+            x, loss_acc, *rest = value
+            mb_mask = rest[0] if rest else None
             ctx = ForwardContext(
                 train=train,
                 rng=None if rng is None
                 else jax.random.fold_in(rng, m * n_stage + s),
+                labels=None if mb_mask is None
+                else LabelInfo(fields={}, mask=mb_mask),
                 epoch=epoch, loss_scale=loss_scale, mesh=mesh)
-            nodes = {in_nodes[s]: value}
+            nodes = {in_nodes[s]: x}
             for j in range(s0, s1):
                 conn = net.connections[j]
                 ins = [nodes[n] for n in conn.nindex_in]
@@ -164,7 +179,9 @@ def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
                 outs, _ = conn.layer.forward(p, {}, ins, ctx)
                 for n, v in zip(conn.nindex_out, outs):
                     nodes[n] = v
-            return nodes[out_nodes[s]]
+            for l in ctx.losses:
+                loss_acc = loss_acc + l
+            return (nodes[out_nodes[s]], loss_acc, *rest)
         return fn
 
     return [mk(s, s0, s1) for s, (s0, s1) in enumerate(stages)]

@@ -362,24 +362,30 @@ class NetTrainer:
             f"{n_micro}")
         x = data.astype(self.dtype).reshape(n_micro, b // n_micro,
                                             *data.shape[1:])
-        out = pipeline_apply_hetero(
+        out, aux_losses = pipeline_apply_hetero(
             stage_fns, params, x, mesh=self.mesh,
-            data_spec=self.batch_shard.spec)
+            data_spec=self.batch_shard.spec,
+            mask=None if mask is None
+            else mask.reshape(n_micro, b // n_micro))
         out_flat = out.reshape(b, *out.shape[2:])
-        # loss tail (self-loop loss layers) outside the pipeline
+        # loss tail (self-loop loss layers) outside the pipeline; mid-body
+        # aux terms (MoE load balance) arrive threaded through the stages
         return self._run_loss_tail(params, out_flat, body_end, label_vec,
-                                   rng, epoch, mask)
+                                   rng, epoch, mask, train=train,
+                                   body_loss=aux_losses.sum())
 
     def _run_loss_tail(self, params, body_out, body_end, label_vec, rng,
-                       epoch, mask):
+                       epoch, mask, *, train, body_loss=None):
         """Run the trailing loss connections on the body output; shared by
-        the remat and pipeline paths.  Returns (tail node env, ctx)."""
+        the remat and pipeline paths.  ``body_loss`` carries aux-loss terms
+        contributed inside the partitioned body.  Returns
+        (tail node env, ctx)."""
         from . import pipeline_net
         out_node = pipeline_net._boundary_node(self.net, body_end, body_end)
         fields = {name: label_vec[:, a:b_]
                   for name, a, b_ in self._label_fields} \
             if label_vec is not None else {}
-        ctx = ForwardContext(train=True, rng=rng,
+        ctx = ForwardContext(train=train, rng=rng,
                              labels=LabelInfo(fields=fields, mask=mask)
                              if fields else None,
                              epoch=epoch, loss_scale=self.loss_scale,
@@ -391,6 +397,8 @@ class NetTrainer:
             outs, _ = conn.layer.forward(p, {}, ins, ctx)
             for n, v in zip(conn.nindex_out, outs):
                 nodes[n] = v
+        if body_loss is not None and ctx.losses:
+            ctx.losses.append(body_loss)
         return nodes, ctx
 
     def _remat_forward(self, params, data, label_vec, *, rng, epoch,
@@ -407,11 +415,16 @@ class NetTrainer:
             self.net, stages, body_end, train=True, epoch=epoch,
             loss_scale=self.loss_scale, rng=rng,
             mesh=self.mesh if self.mesh.size > 1 else None)
-        x = self._normalize_input(data).astype(self.dtype)
+        val = (self._normalize_input(data).astype(self.dtype),
+               jnp.float32(0.0))
+        if mask is not None:
+            val = val + (mask,)
         for fn in stage_fns:
-            x = jax.checkpoint(fn)(params, x, 0)
+            val = jax.checkpoint(fn)(params, val, 0)
+        x, body_loss = val[0], val[1]
         return self._run_loss_tail(params, x, body_end, label_vec, rng,
-                                   epoch, mask)
+                                   epoch, mask, train=True,
+                                   body_loss=body_loss)
 
     def _loss_and_grads(self, params, buffers, data, label_vec, extras,
                         epoch, rng, eval_ids, mask=None):

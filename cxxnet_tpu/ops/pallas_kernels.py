@@ -517,7 +517,10 @@ def _mp_hwcn_fwd(xt, k, s, interpret):
     h, w, c, n = xt.shape
     oh = min(h - k + s - 1, h - 1) // s + 1
     ow = min(w - k + s - 1, w - 1) // s + 1
-    wpad = -(-w // s) * s
+    # phases must hold the deepest column tap: slice [j//s : j//s + ow]
+    # with j up to k-1 needs (k-1)//s + ow entries per phase, which on
+    # clipped tail windows (even w, k=3, s=2) exceeds ceil(w/s)
+    wpad = max(-(-w // s), (k - 1) // s + ow) * s
     nb = 128 if n % 128 == 0 else n
     cb = c
     while (w * cb * nb * 4) * (k + 2) > (10 << 20) and cb % 2 == 0:
@@ -546,7 +549,7 @@ def _mp_hwcn_fwd(xt, k, s, interpret):
 def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
     h, w, c, n = xt.shape
     oh, ow = pt.shape[0], pt.shape[1]
-    wpad = -(-w // s) * s
+    wpad = max(-(-w // s), (k - 1) // s + ow) * s  # see _mp_hwcn_fwd
     ncand = -(-k // s)
     nb = 128 if n % 128 == 0 else n
     kw = {} if _VMEM is None else {"memory_space": _VMEM}

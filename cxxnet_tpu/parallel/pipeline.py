@@ -90,19 +90,26 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jnp.ndarray,
 
 
 def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
-                          axis: str = "pipe",
-                          data_spec: P = P()) -> jnp.ndarray:
+                          axis: str = "pipe", data_spec: P = P(),
+                          mask=None) -> jnp.ndarray:
     """GPipe schedule over *heterogeneous* stages (different activation
     shapes and per-stage parameter structures) — the form a real layered
     network needs (a conv stack's stage boundaries are pool/flatten shapes,
     not one repeated block).
 
     ``stage_fns[s](params, value, m)``: stage ``s`` maps its input-boundary
-    activation to its output-boundary activation for microbatch index ``m``
-    (for per-microbatch randomness).  ``params`` is passed whole and
-    replicated over ``axis``; each branch uses only its own stage's slices.
-    ``x``: (n_micro, mb, ...) microbatches.  Returns (n_micro, mb, ...) of
-    the LAST stage's outputs.
+    ``(activation, aux_loss)`` pair to its output-boundary pair for
+    microbatch index ``m`` (for per-microbatch randomness).  The scalar
+    aux-loss accumulator rides along the pipeline so mid-body loss
+    contributors (MoE load-balance terms) are not dropped.  ``params`` is
+    passed whole and replicated over ``axis``; each branch uses only its
+    own stage's slices.  ``x``: (n_micro, mb, ...) microbatches.  Returns
+    ``(outs, aux_losses)``: (n_micro, mb, ...) of the LAST stage's output
+    activations and an (n_micro,) vector of per-microbatch aux losses
+    (summed over any data-axis shards, replicated on return).  ``mask``,
+    when given, is the (n_micro, mb) tail-batch loss mask, threaded to
+    every stage so mid-body loss contributors can exclude replica
+    instances.
 
     Mechanics: the scan carry holds one activation buffer per stage
     boundary (a K-tuple, since shapes differ a single rotating buffer can't
@@ -121,12 +128,25 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
     ticks = n_micro + n_stage - 1
     perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
-    def spmd(params, xs):
+    data_axes = [a for d in data_spec if d is not None
+                 for a in (d if isinstance(d, tuple) else (d,))]
+
+    def spmd(params, xs, *mrest):
+        ms = mrest[0] if mrest else None
         idx = lax.axis_index(axis)
+
+        def inject(t):
+            m = jnp.clip(t, 0, n_micro - 1)
+            val = (xs[m], jnp.float32(0.0))
+            # tail-batch loss mask rides the boundary tuples so mid-body
+            # loss contributors see it (sharded like the data, unlike a
+            # closure constant would be)
+            return val if ms is None else val + (ms[m],)
+
         # boundary shapes, derived on the *local* (possibly data-sharded)
         # microbatch without running anything
         bshapes = []
-        cur = jax.eval_shape(lambda a: a[0], xs)
+        cur = jax.eval_shape(inject, jnp.int32(0))
         for fn in stage_fns:
             cur = jax.eval_shape(lambda p, v, fn=fn: fn(p, v, 0),
                                  params, cur)
@@ -135,8 +155,7 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
         def tick(bufs, t):
             def mk_branch(s):
                 def branch(bufs):
-                    inp = xs[jnp.clip(t, 0, n_micro - 1)] if s == 0 \
-                        else bufs[s - 1]
+                    inp = inject(t) if s == 0 else bufs[s - 1]
                     m = jnp.clip(t - s, 0, n_micro - 1)
                     y = stage_fns[s](params, inp, m)
                     return tuple(y if j == s else b
@@ -146,21 +165,37 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
             bufs = lax.switch(idx, [mk_branch(s) for s in range(n_stage)],
                               bufs)
             y_last = bufs[n_stage - 1]
-            bufs = tuple(lax.ppermute(b, axis, perm) for b in bufs)
+            bufs = tuple(
+                jax.tree.map(lambda a: lax.ppermute(a, axis, perm), b)
+                for b in bufs)
             return bufs, y_last
 
-        init = tuple(jnp.zeros(s.shape, s.dtype) for s in bshapes)
+        init = tuple(jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), b)
+                     for b in bshapes)
         _, ys = lax.scan(tick, init, jnp.arange(ticks))
-        out_last = ys[n_stage - 1:]              # (n_micro, mb, ...)
-        mask = (idx == n_stage - 1).astype(out_last.dtype)
-        return lax.psum(out_last * mask, axis)
+        # microbatch m leaves the last stage at tick m + S - 1
+        out_last = jax.tree.map(lambda a: a[n_stage - 1:], ys)
+        valid = idx == n_stage - 1
+        out_last = jax.tree.map(
+            lambda a: a * valid.astype(a.dtype), out_last)
+        coll = lax.psum(out_last, axis)
+        out, losses = coll[0], coll[1]  # drop the mask leaf, if any
+        # per-microbatch aux losses were computed on this device's data
+        # shard; sum them so the return value is replicated
+        if data_axes:
+            losses = lax.psum(losses, tuple(data_axes))
+        return out, losses
 
     pspec = jax.tree.map(lambda _: P(), params)
     xspec = P(None, *data_spec)
+    operands, in_specs = (params, x), (pspec, xspec)
+    if mask is not None:
+        operands += (mask,)
+        in_specs += (P(None, *list(data_spec)[:1]),)
     return shard_map(
         spmd, mesh=mesh,
-        in_specs=(pspec, xspec), out_specs=xspec,
-        check_rep=False)(params, x)
+        in_specs=in_specs, out_specs=(xspec, P(None)),
+        check_rep=False)(*operands)
 
 
 def pipeline_train_step(stage_fn, loss_fn, stacked_params, x, labels, *,

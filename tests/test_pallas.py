@@ -284,6 +284,9 @@ def test_lrn_hwcn_matches_xla():
     ((2, 8, 13, 13), 3, 2),    # clipped tail
     ((2, 8, 12, 12), 2, 2),    # VGG/LeNet family
     ((2, 8, 9, 9), 3, 1),      # inception same-size branch (no pad)
+    ((2, 8, 12, 12), 3, 2),    # even width + clipped tail: the tap slice
+    ((2, 8, 14, 14), 3, 2),    # needs (k-1)//s + ow > ceil(w/s) phase
+    ((2, 8, 56, 56), 3, 2),    # entries (GoogLeNet pool shapes 112/56/14)
 ])
 def test_max_pool_hwcn_matches_eq(shape, k, s):
     """Native-layout pool kernel == reference rule fwd; backward == exact
