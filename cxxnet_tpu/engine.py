@@ -1,0 +1,81 @@
+"""Engine tuning options, settable from the config surface.
+
+These select between measured-equivalent lowerings of the same math
+(gradient-semantics variants are called out below).  Each was an
+environment variable in earlier rounds; the config file is this
+framework's API surface (the reference drives everything through
+``name = value`` pairs, SURVEY.md §5.6), so they are first-class config
+keys now — ``pool_bwd = eq`` in a .conf does what
+``CXXNET_POOL_BWD=eq`` does.  Env vars still work and set the default;
+a config key wins over the env var.
+
+Options are read at trace time: set them before the first train/eval
+step compiles (the CLI applies config before ``init_model``).  Changing
+one mid-run does not retrace already-compiled steps.
+
+| key         | values                     | meaning                        |
+|-------------|----------------------------|--------------------------------|
+| pool_bwd    | sas (default), eq, gather  | max-pool backward: XLA select- |
+|             |                            | and-scatter (one argmax per    |
+|             |                            | window) vs exact mshadow all-  |
+|             |                            | ties unpool (eq == gather)     |
+| pool_layout | nchw (default), chwn, hwcn | pool compute layout; hwcn =    |
+|             |                            | native-layout Pallas kernels   |
+|             |                            | (implies all-ties backward)    |
+| fast_wgrad  | s2d (default), hwcn,       | wgrad lowering for small-cin   |
+|             | pallas, off                | strided convs (AlexNet conv1)  |
+| group_conv  | fgc (default), split       | grouped-conv lowering          |
+| conv1_fwd   | conv (default), s2d        | forward lowering for the fast- |
+|             |                            | wgrad conv class               |
+| pallas_lrn  | hwcn (default), 1, 0       | LRN kernel dispatch            |
+| relu_vjp    | out (default), xla         | relu backward formulation      |
+| flash_attn  | 1 (default), 0             | Pallas flash attention on TPU  |
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFS = {
+    # name: (env var, default, valid values, env value is inverted bool)
+    "pool_bwd": ("CXXNET_POOL_BWD", "sas", ("sas", "eq", "gather")),
+    "pool_layout": ("CXXNET_POOL_LAYOUT", "nchw", ("nchw", "chwn", "hwcn")),
+    "fast_wgrad": ("CXXNET_FAST_WGRAD", "s2d",
+                   ("s2d", "hwcn", "pallas", "off")),
+    "group_conv": ("CXXNET_GROUP_CONV", "fgc", ("fgc", "split")),
+    "conv1_fwd": ("CXXNET_CONV1_FWD", "conv", ("conv", "s2d")),
+    "pallas_lrn": ("CXXNET_PALLAS_LRN", "hwcn", ("hwcn", "1", "0")),
+    "relu_vjp": ("CXXNET_RELU_VJP", "out", ("out", "xla")),
+    "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),
+}
+
+
+class _Options:
+    def __init__(self):
+        for name, (env, default, valid) in _DEFS.items():
+            if name == "flash_attn":
+                # legacy env var is an opt-OUT (CXXNET_NO_FLASH_ATTN=1)
+                val = "0" if os.environ.get(env) else "1"
+            else:
+                val = os.environ.get(env, default)
+            assert val in valid, (
+                f"env {env} = {val}: expected one of {valid}")
+            setattr(self, name, val)
+
+    def set(self, name: str, val: str) -> None:
+        assert name in _DEFS, f"unknown engine option {name}"
+        valid = _DEFS[name][2]
+        assert val in valid, (
+            f"engine option {name} = {val}: expected one of {valid}")
+        setattr(self, name, val)
+
+
+opts = _Options()
+
+
+def is_engine_option(name: str) -> bool:
+    return name in _DEFS
+
+
+def set_engine_option(name: str, val: str) -> None:
+    opts.set(name, val)

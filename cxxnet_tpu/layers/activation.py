@@ -13,19 +13,19 @@ layer and jax.grad supplies the exact same gradients.
 
 from __future__ import annotations
 
-import os
 from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .base import ForwardContext, Layer, Params, Shape4
+from ..engine import opts
 
 # relu backward formulation: "out" (default) masks the gradient from the
 # relu OUTPUT via a custom VJP (reference op.h relu_grad semantics; saves
 # the pre-activation residual); "xla" uses plain jnp.maximum and lets
 # jax/XLA pick (residual = mask from input).  Toggle for A/B measurement.
-_RELU_VJP = os.environ.get("CXXNET_RELU_VJP", "out")
+# (config key relu_vjp / env CXXNET_RELU_VJP -> engine.opts)
 
 
 class _UnaryLayer(Layer):
@@ -69,7 +69,7 @@ class ReluLayer(_UnaryLayer):
         # pre-activation, which forces XLA to keep BOTH conv-out and
         # relu-out alive to the backward pass — an extra full-activation
         # HBM write per conv+relu pair (~1.3 GB/step on AlexNet b1024).
-        if _RELU_VJP == "xla":
+        if opts.relu_vjp == "xla":
             return jnp.maximum(x, 0)
         return _relu_out_grad(x)
 

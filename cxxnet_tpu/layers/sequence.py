@@ -42,12 +42,13 @@ def _single_device_attention(q, k, v, causal: bool):
     """Single-device attention dispatch: the Pallas flash kernel on TPU
     (VMEM-resident scores; measured 3.2x the XLA chunked path forward at
     s=8192 on v5e, and the only path whose backward fits at that length),
-    XLA dense/chunked otherwise.  CXXNET_NO_FLASH_ATTN=1 opts out."""
-    import os
+    XLA dense/chunked otherwise.  Config key ``flash_attn = 0`` (or env
+    CXXNET_NO_FLASH_ATTN=1) opts out."""
+    from ..engine import opts
     from ..ops import pallas_kernels as pk
     s, hd = q.shape[2], q.shape[3]
     if (pk._on_tpu() and pk.flash_attention_available(s, hd)
-            and not os.environ.get("CXXNET_NO_FLASH_ATTN")):
+            and opts.flash_attn == "1"):
         return pk.flash_attention(q, k, v, causal)
     return ring.dense_attention(q, k, v, causal=causal)
 

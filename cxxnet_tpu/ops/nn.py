@@ -15,7 +15,6 @@ TPU layout.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import Tuple
 
@@ -24,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..engine import opts
+
 # LRN kernel dispatch.  Default "hwcn": the Pallas kernel in XLA's native
 # (H, W, C-sublane, N-lane) activation layout — the boundary transposes are
 # bitcasts, and the measured full-step win on v5e is 2.5 ms (53.6 -> 51.1,
@@ -31,7 +32,7 @@ from jax import lax
 # relayout reason this form avoids).  "1" = the legacy (N, C, HW) kernel,
 # "0" = pure XLA.  Shapes whose (W, C, 128-lane) f32 working set exceeds
 # VMEM fall back to XLA automatically.
-_PALLAS_LRN = os.environ.get("CXXNET_PALLAS_LRN", "hwcn")
+# (config key pallas_lrn / env CXXNET_PALLAS_LRN -> engine.opts)
 
 
 def _lrn_hwcn_fits(shape) -> bool:
@@ -72,7 +73,7 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int = 1,
     operands on TPU; an explicit preferred_element_type would break the
     conv transpose/grad rule's same-dtype requirement).
     """
-    if num_group > 1 and _GROUP_CONV == "split":
+    if num_group > 1 and opts.group_conv == "split":
         # A/B probe: grouped conv as per-group convs + concat (XLA's
         # feature_group_count dgrad measured 2.9 ms vs ~1.2 roofline on
         # AlexNet conv2; separate convs give XLA independent layouts)
@@ -154,26 +155,26 @@ def s2d_input(x: jnp.ndarray, stride: int, kh: int, kw: int,
 # "pallas" uses the in-VMEM im2col Pallas kernel (interpret-only for now —
 # its minor-dim reshapes are rejected by Mosaic on real TPU); "off" keeps
 # XLA's dilated formulation.
-_FAST_WGRAD = os.environ.get("CXXNET_FAST_WGRAD", "s2d")
+# (config key fast_wgrad / env CXXNET_FAST_WGRAD -> engine.opts)
 
 
 def use_fast_wgrad(cin: int, stride: int, num_group: int) -> bool:
     """The geometry class where XLA's dilated wgrad starves the MXU."""
     import jax
-    return (_FAST_WGRAD != "off" and num_group == 1 and stride >= 2
+    return (opts.fast_wgrad != "off" and num_group == 1 and stride >= 2
             and cin <= 4 and jax.default_backend() == "tpu")
 
 
 # grouped-conv lowering: "fgc" (default) XLA feature_group_count;
 # "split" lowers each group as its own conv + concat (A/B probe for the
 # grouped dgrad cost)
-_GROUP_CONV = os.environ.get("CXXNET_GROUP_CONV", "fgc")
+# (config key group_conv / env CXXNET_GROUP_CONV -> engine.opts)
 
 
 # forward lowering for the fast-wgrad conv class: "conv" (default) XLA
 # strided conv; "s2d" routes the forward through the space-to-depth
 # identity too (A/B probe; round-2 measured it slower on v5e)
-_FAST_CONV_FWD = os.environ.get("CXXNET_CONV1_FWD", "conv")
+# (config key conv1_fwd / env CXXNET_CONV1_FWD -> engine.opts)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -186,7 +187,7 @@ def conv_bias_fast(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
     and dx through XLA's transposed conv — which XLA dead-code-eliminates
     when the conv sits on the data layer, the AlexNet conv1 case.
     """
-    if _FAST_CONV_FWD == "s2d":
+    if opts.conv1_fwd == "s2d":
         out = conv2d_s2d(x, w, stride=stride, pad_y=pad_y, pad_x=pad_x)
     else:
         out = conv2d(x, w, stride=stride, pad_y=pad_y, pad_x=pad_x)
@@ -200,7 +201,7 @@ def _conv_bias_fast_fwd(x, w, b, stride, pad_y, pad_x):
 def _conv_bias_fast_bwd(stride, pad_y, pad_x, res, dy):
     x, w = res
     co, ci, kh, kw = w.shape
-    if _FAST_WGRAD == "hwcn":
+    if opts.fast_wgrad == "hwcn":
         # native-layout Pallas kernel (lane-contraction dots; bias grad
         # rides along) — the round-3 formulation that compiles on real TPU
         from .pallas_kernels import conv_wgrad_hwcn_pallas
@@ -208,7 +209,7 @@ def _conv_bias_fast_bwd(stride, pad_y, pad_x, res, dy):
                                         pad_y=pad_y, pad_x=pad_x)
         dw = dw.astype(w.dtype)
         db = db.astype(w.dtype)
-    elif _FAST_WGRAD == "pallas":
+    elif opts.fast_wgrad == "pallas":
         from .pallas_kernels import conv_wgrad_s2d_pallas
         # interpret=True: Mosaic rejects the kernel's minor-dim reshapes on
         # real TPU (see conv_wgrad_s2d_pallas), so this mode is a
@@ -263,7 +264,7 @@ def _pool_padding(h: int, w: int, kh: int, kw: int, stride: int,
 # semantics (ties get gradient at EVERY maximum), but ~1.8x slower on v5e
 # (95.6ms vs 53.3ms AlexNet b1024 step) because the kx*ky dilate-and-add
 # passes materialize instead of fusing.
-_POOL_BWD = os.environ.get("CXXNET_POOL_BWD", "sas")
+# (config key pool_bwd / env CXXNET_POOL_BWD -> engine.opts)
 
 
 def _max_pool_raw(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
@@ -335,7 +336,7 @@ def _max_pool_eq_bwd(ksize_y, ksize_x, stride, pad_y, pad_x, res, dy):
     "eq" = kx*ky dilate-and-add passes (measured ~1.8x slower than SAS in
     a full AlexNet step on v5e: the pads materialize); "gather" =
     candidate-window gathers (_max_pool_eq_bwd_gather)."""
-    if _POOL_BWD == "gather":
+    if opts.pool_bwd == "gather":
         return _max_pool_eq_bwd_gather(ksize_y, ksize_x, stride,
                                        pad_y, pad_x, res, dy)
     x, y = res
@@ -373,11 +374,11 @@ _max_pool_eq.defvjp(_max_pool_eq_fwd, _max_pool_eq_bwd)
 # (AlexNet pool1, b1024): fwd 0.99ms vs 2.93 NCHW, SAS bwd 5.06 vs 8.47 —
 # XLA tiles the windowed ops far better with batch minor; whether the
 # transposes get absorbed in a full step is measured via fb.py.
-_POOL_LAYOUT = os.environ.get("CXXNET_POOL_LAYOUT", "nchw")
+# (config key pool_layout / env CXXNET_POOL_LAYOUT -> engine.opts)
 
 
 def _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x):
-    if _POOL_BWD in ("eq", "gather"):
+    if opts.pool_bwd in ("eq", "gather"):
         return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     return _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
 
@@ -386,7 +387,7 @@ def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
                pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
     hwcn_ok = (pad_y == 0 and pad_x == 0 and ksize_y == ksize_x
                and jax.default_backend() == "tpu" and x.shape[0] % 128 == 0)
-    want_allties = _POOL_LAYOUT == "hwcn" or _POOL_BWD in ("eq", "gather")
+    want_allties = opts.pool_layout == "hwcn" or opts.pool_bwd in ("eq", "gather")
     if want_allties and hwcn_ok:
         # Pallas kernels in XLA's native (H, W, C, N) activation layout:
         # exact mshadow all-ties backward, ~15x faster than the XLA
@@ -394,12 +395,12 @@ def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
         # pool1 b1024; still slower than SAS, so an exactness opt-in)
         from .pallas_kernels import max_pool_hwcn
         return max_pool_hwcn(x, ksize_y, stride)
-    if _POOL_LAYOUT == "hwcn" and not hwcn_ok:
+    if opts.pool_layout == "hwcn" and not hwcn_ok:
         # keep all-ties semantics for the shapes the kernel can't take
         # (padded pools, partial batches, CPU) — gradient semantics must
         # not flip with batch divisibility mid-run
         return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
-    if _POOL_LAYOUT == "chwn" and _POOL_BWD == "sas":
+    if opts.pool_layout == "chwn" and opts.pool_bwd == "sas":
         xt = jnp.transpose(x, (1, 2, 3, 0))
         # reuse the NCHW padding/window logic by viewing (C, H, W, N) as
         # (N', C', H, W) with batch'=C and channel'=H: reduce_window only
@@ -500,10 +501,10 @@ def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float, knorm: float
         ) -> jnp.ndarray:
     """Local response normalization across channels
     (reference lrn_layer-inl.hpp:53-56): out = x * (k + a/n * sum x^2)^-b."""
-    if _PALLAS_LRN == "1":
+    if opts.pallas_lrn == "1":
         from .pallas_kernels import lrn_pallas
         return lrn_pallas(x, nsize, alpha, beta, knorm)
-    if _PALLAS_LRN == "hwcn" and _lrn_hwcn_fits(x.shape):
+    if opts.pallas_lrn == "hwcn" and _lrn_hwcn_fits(x.shape):
         # kernel in XLA's native (H, W, C, N) activation layout — the
         # boundary transposes are bitcasts, not relayouts
         from .pallas_kernels import lrn_pallas_hwcn

@@ -91,7 +91,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jnp.ndarray,
 
 def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
                           axis: str = "pipe", data_spec: P = P(),
-                          mask=None) -> jnp.ndarray:
+                          mask=None
+                          ) -> "tuple[jnp.ndarray, jnp.ndarray]":
     """GPipe schedule over *heterogeneous* stages (different activation
     shapes and per-stage parameter structures) — the form a real layered
     network needs (a conv stack's stage boundaries are pool/flatten shapes,

@@ -44,8 +44,9 @@ def test_lrn_pallas_grad(nsize, beta):
 
 
 def test_lrn_dispatch_forced_pallas(monkeypatch):
-    """nn.lrn routes through the Pallas kernel when CXXNET_PALLAS_LRN=1."""
-    monkeypatch.setattr(N, "_PALLAS_LRN", "1")
+    """nn.lrn routes through the Pallas kernel when pallas_lrn = 1."""
+    from cxxnet_tpu.engine import opts
+    monkeypatch.setattr(opts, "pallas_lrn", "1")
     x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 3, 3), jnp.float32)
     got = N.lrn(x, 5, 0.001, 0.75, 1.0)
     want = _xla_lrn(x, 5, 0.001, 0.75, 1.0)
