@@ -374,26 +374,32 @@ class NetTrainer:
             f"{n_micro}")
         x = data.astype(self.dtype).reshape(n_micro, b // n_micro,
                                             *data.shape[1:])
-        out, aux_losses = pipeline_apply_hetero(
+        mb = b // n_micro
+        extra = {
+            "fields": {name: label_vec[:, a:b_].reshape(n_micro, mb, -1)
+                       for name, a, b_ in self._label_fields}
+            if label_vec is not None else {},
+            "mask": None if mask is None else mask.reshape(n_micro, mb),
+        }
+        outs, aux_losses = pipeline_apply_hetero(
             stage_fns, params, x, mesh=self.mesh,
-            data_spec=self.batch_shard.spec,
-            mask=None if mask is None
-            else mask.reshape(n_micro, b // n_micro))
-        out_flat = out.reshape(b, *out.shape[2:])
+            data_spec=self.batch_shard.spec, extra=extra)
+        nodes = {n: o.reshape(b, *o.shape[2:])
+                 for n, o in zip(
+                     pipeline_net.frontier_nodes(self.net, body_end), outs)}
         # loss tail (self-loop loss layers) outside the pipeline; mid-body
-        # aux terms (MoE load balance) arrive threaded through the stages
-        return self._run_loss_tail(params, out_flat, body_end, label_vec,
+        # loss terms (MoE load balance, aux heads) arrive threaded through
+        # the stages
+        return self._run_loss_tail(params, nodes, body_end, label_vec,
                                    rng, epoch, mask, train=train,
                                    body_loss=aux_losses.sum())
 
-    def _run_loss_tail(self, params, body_out, body_end, label_vec, rng,
+    def _run_loss_tail(self, params, nodes, body_end, label_vec, rng,
                        epoch, mask, *, train, body_loss=None):
-        """Run the trailing loss connections on the body output; shared by
-        the remat and pipeline paths.  ``body_loss`` carries aux-loss terms
-        contributed inside the partitioned body.  Returns
-        (tail node env, ctx)."""
-        from . import pipeline_net
-        out_node = pipeline_net._boundary_node(self.net, body_end, body_end)
+        """Run the trailing loss connections on the body-boundary node
+        env; shared by the remat and pipeline paths.  ``body_loss``
+        carries loss terms contributed inside the partitioned body.
+        Returns (tail node env, ctx)."""
         fields = {name: label_vec[:, a:b_]
                   for name, a, b_ in self._label_fields} \
             if label_vec is not None else {}
@@ -402,14 +408,17 @@ class NetTrainer:
                              if fields else None,
                              epoch=epoch, loss_scale=self.loss_scale,
                              mesh=self.mesh if self.mesh.size > 1 else None)
-        nodes = {out_node: body_out}
+        nodes = dict(nodes)
         for conn in self.net.connections[body_end:]:
             ins = [nodes[n] for n in conn.nindex_in]
             p = params.get(conn.param_key, {})
             outs, _ = conn.layer.forward(p, {}, ins, ctx)
             for n, v in zip(conn.nindex_out, outs):
                 nodes[n] = v
-        if body_loss is not None and ctx.losses:
+        if body_loss is not None:
+            # unconditional: a net whose loss layers are ALL mid-body has
+            # an empty tail, and its entire training loss is the threaded
+            # term
             ctx.losses.append(body_loss)
         return nodes, ctx
 
@@ -427,14 +436,20 @@ class NetTrainer:
             self.net, stages, body_end, train=True, epoch=epoch,
             loss_scale=self.loss_scale, rng=rng,
             mesh=self.mesh if self.mesh.size > 1 else None)
+        extra = {
+            "fields": {name: label_vec[:, a:b_]
+                       for name, a, b_ in self._label_fields}
+            if label_vec is not None else {},
+            "mask": mask,
+        }
         val = (self._normalize_input(data).astype(self.dtype),
-               jnp.float32(0.0))
-        if mask is not None:
-            val = val + (mask,)
+               jnp.float32(0.0), extra)
         for fn in stage_fns:
             val = jax.checkpoint(fn)(params, val, 0)
-        x, body_loss = val[0], val[1]
-        return self._run_loss_tail(params, x, body_end, label_vec, rng,
+        acts, body_loss = val[0], val[1]
+        nodes = dict(zip(
+            pipeline_net.frontier_nodes(self.net, body_end), acts))
+        return self._run_loss_tail(params, nodes, body_end, label_vec, rng,
                                    epoch, mask, train=True,
                                    body_loss=body_loss)
 
@@ -448,10 +463,12 @@ class NetTrainer:
                 "schedule already bounds live activations per stage)")
             assert not extras, "remat: extra-data inputs unsupported"
 
+            assert any(c.layer.is_loss for c in self.net.connections), \
+                "network has no loss layer; cannot train"
+
             def loss_fn(p):
                 nodes, ctx = self._remat_forward(
                     p, data, label_vec, rng=rng, epoch=epoch, mask=mask)
-                assert ctx.losses, "network has no loss layer; cannot train"
                 total = sum(ctx.losses[1:], ctx.losses[0])
                 for nid in eval_ids:
                     assert nid in nodes, (
@@ -465,11 +482,13 @@ class NetTrainer:
         if self._pipelined:
             assert not extras, "pipeline: extra-data inputs unsupported"
 
+            assert any(c.layer.is_loss for c in self.net.connections), \
+                "network has no loss layer; cannot train"
+
             def loss_fn(p):
                 nodes, ctx = self._pipeline_forward(
                     p, data, label_vec, train=True, rng=rng, epoch=epoch,
                     mask=mask)
-                assert ctx.losses, "network has no loss layer; cannot train"
                 total = sum(ctx.losses[1:], ctx.losses[0])
                 for nid in eval_ids:
                     assert nid in nodes, (

@@ -91,26 +91,28 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jnp.ndarray,
 
 def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
                           axis: str = "pipe", data_spec: P = P(),
-                          mask=None
-                          ) -> "tuple[jnp.ndarray, jnp.ndarray]":
+                          extra=None
+                          ) -> "tuple[tuple, jnp.ndarray]":
     """GPipe schedule over *heterogeneous* stages (different activation
     shapes and per-stage parameter structures) — the form a real layered
     network needs (a conv stack's stage boundaries are pool/flatten shapes,
     not one repeated block).
 
     ``stage_fns[s](params, value, m)``: stage ``s`` maps its input-boundary
-    ``(activation, aux_loss)`` pair to its output-boundary pair for
-    microbatch index ``m`` (for per-microbatch randomness).  The scalar
-    aux-loss accumulator rides along the pipeline so mid-body loss
-    contributors (MoE load-balance terms) are not dropped.  ``params`` is
-    passed whole and replicated over ``axis``; each branch uses only its
-    own stage's slices.  ``x``: (n_micro, mb, ...) microbatches.  Returns
-    ``(outs, aux_losses)``: (n_micro, mb, ...) of the LAST stage's output
-    activations and an (n_micro,) vector of per-microbatch aux losses
-    (summed over any data-axis shards, replicated on return).  ``mask``,
-    when given, is the (n_micro, mb) tail-batch loss mask, threaded to
-    every stage so mid-body loss contributors can exclude replica
-    instances.
+    ``(acts, aux_loss, extra)`` value to its output-boundary value for
+    microbatch index ``m`` (for per-microbatch randomness); ``acts`` is the
+    tuple of frontier activations crossing the boundary (stage 0 receives
+    a bare microbatch array).  The scalar aux-loss accumulator rides along
+    the pipeline so mid-body loss contributors (MoE load-balance terms,
+    aux-head losses) are not dropped.  ``params`` is passed whole and
+    replicated over ``axis``; each branch uses only its own stage's
+    slices.  ``x``: (n_micro, mb, ...) microbatches.  Returns
+    ``(outs, aux_losses)``: a tuple of (n_micro, mb, ...) stacks of the
+    LAST stage's boundary activations and an (n_micro,) vector of
+    per-microbatch aux losses (summed over any data-axis shards,
+    replicated on return).  ``extra``, when given, is a pytree with
+    (n_micro, mb, ...) leaves (label fields / tail-batch loss mask),
+    sliced per microbatch and threaded to every stage.
 
     Mechanics: the scan carry holds one activation buffer per stage
     boundary (a K-tuple, since shapes differ a single rotating buffer can't
@@ -132,33 +134,37 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
     data_axes = [a for d in data_spec if d is not None
                  for a in (d if isinstance(d, tuple) else (d,))]
 
-    def spmd(params, xs, *mrest):
-        ms = mrest[0] if mrest else None
+    def spmd(params, xs, *erest):
         idx = lax.axis_index(axis)
 
-        def inject(t):
-            m = jnp.clip(t, 0, n_micro - 1)
-            val = (xs[m], jnp.float32(0.0))
-            # tail-batch loss mask rides the boundary tuples so mid-body
-            # loss contributors see it (sharded like the data, unlike a
-            # closure constant would be)
-            return val if ms is None else val + (ms[m],)
+        def extra_at(m):
+            # label fields / tail-batch mask are sliced from the sharded
+            # operand by each stage's own microbatch index — they do NOT
+            # ride the rotating boundary buffers (no ppermute/psum cost)
+            return jax.tree.map(lambda a: a[m], erest[0]) if erest \
+                else {"fields": {}, "mask": None}
+
+        def run_stage(s, inp, m):
+            acts, loss = inp
+            y = stage_fns[s](params, (acts, loss, extra_at(m)), m)
+            return y[0], y[1]
 
         # boundary shapes, derived on the *local* (possibly data-sharded)
         # microbatch without running anything
         bshapes = []
-        cur = jax.eval_shape(inject, jnp.int32(0))
-        for fn in stage_fns:
-            cur = jax.eval_shape(lambda p, v, fn=fn: fn(p, v, 0),
+        cur = jax.eval_shape(lambda: (xs[0], jnp.float32(0.0)))
+        for s, fn in enumerate(stage_fns):
+            cur = jax.eval_shape(lambda p, v, s=s: run_stage(s, v, 0),
                                  params, cur)
             bshapes.append(cur)
 
         def tick(bufs, t):
             def mk_branch(s):
                 def branch(bufs):
-                    inp = inject(t) if s == 0 else bufs[s - 1]
+                    inp = (xs[jnp.clip(t, 0, n_micro - 1)],
+                           jnp.float32(0.0)) if s == 0 else bufs[s - 1]
                     m = jnp.clip(t - s, 0, n_micro - 1)
-                    y = stage_fns[s](params, inp, m)
+                    y = run_stage(s, inp, m)
                     return tuple(y if j == s else b
                                  for j, b in enumerate(bufs))
                 return branch
@@ -179,8 +185,7 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
         valid = idx == n_stage - 1
         out_last = jax.tree.map(
             lambda a: a * valid.astype(a.dtype), out_last)
-        coll = lax.psum(out_last, axis)
-        out, losses = coll[0], coll[1]  # drop the mask leaf, if any
+        out, losses = lax.psum(out_last, axis)
         # per-microbatch aux losses were computed on this device's data
         # shard; sum them so the return value is replicated
         if data_axes:
@@ -190,8 +195,10 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
     pspec = jax.tree.map(lambda _: P(), params)
     xspec = P(None, *data_spec)
     operands, in_specs = (params, x), (pspec, xspec)
-    if mask is not None:
-        operands += (mask,)
+    if extra is not None:
+        operands += (extra,)
+        # one spec leaf prefixing the whole extra subtree: microbatch dim
+        # unsharded, per-microbatch batch dim sharded like the data
         in_specs += (P(None, *list(data_spec)[:1]),)
     return shard_map(
         spmd, mesh=mesh,

@@ -14,9 +14,14 @@ import jax.numpy as jnp
 
 def run(model="vgg16", batch=256, k=4):
     from __graft_entry__ import _make_trainer
-    from cxxnet_tpu.models import vgg
-    conf = vgg(depth=16) + "metric = error\neta = 0.01\nmomentum = 0.9\n" \
-        "silent = 1\n"
+    from cxxnet_tpu.models.zoo import googlenet, vgg
+    if model == "googlenet":
+        # aux heads ON: partitionable since the multi-node-frontier
+        # partitioner (round 4); the depth-22 trunk needs them to train
+        conf = googlenet(num_class=1000, aux_heads=True)
+    else:
+        conf = vgg(depth=16)
+    conf += "metric = error\neta = 0.01\nmomentum = 0.9\nsilent = 1\n"
     shape = (3, 224, 224)
     for remat in (0, k):
         try:
@@ -47,5 +52,6 @@ def run(model="vgg16", batch=256, k=4):
 
 
 if __name__ == "__main__":
-    run(batch=int(sys.argv[2]) if len(sys.argv) > 2 else 256,
+    run(model=sys.argv[1] if len(sys.argv) > 1 else "vgg16",
+        batch=int(sys.argv[2]) if len(sys.argv) > 2 else 256,
         k=int(sys.argv[3]) if len(sys.argv) > 3 else 4)
