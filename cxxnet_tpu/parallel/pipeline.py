@@ -271,15 +271,19 @@ def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x, labels, *,
             loss_m, dl = lax.cond(
                 idx == n_stage - 1,
                 lambda: jax.value_and_grad(
-                    lambda yv: loss_fn(yv, labs[mb_c]))(y),
+                    lambda yv: loss_fn(yv, labs[mb_c]).astype(
+                        jnp.float32))(y),
                 lambda: (jnp.float32(0.0), jnp.zeros_like(y)))
             g_in = jnp.where(idx == n_stage - 1, dl.astype(y.dtype),
                              bwd_state)
             _, vjp = jax.vjp(stage_fn, p_local, x_saved)
             dp, dx = vjp(g_in)
-            gmul = b_on.astype(jnp.float32)
+            # where-mask, not multiply: bubble ticks run the vjp on
+            # zero/garbage activations, and 0 * NaN would poison the
+            # accumulator permanently
             grad_acc = jax.tree.map(
-                lambda a, d: a + gmul * d.astype(a.dtype), grad_acc, dp)
+                lambda a, d: jnp.where(b_on, a + d.astype(a.dtype), a),
+                grad_acc, dp)
             loss_acc = loss_acc + jnp.where(
                 b_on & (idx == n_stage - 1), loss_m, 0.0)
             return (lax.ppermute(y, axis, fwd_perm),

@@ -1,0 +1,182 @@
+"""Per-op fwd/dgrad/wgrad MXU roofline (VERDICT r3 item 2).
+
+The round-3 roofline modeled the train step as ``3 x t_mxu(fwd)`` —
+assuming dgrad and wgrad tile exactly like the forward.  They don't:
+
+* **fwd**    — matmul M = n*oh*ow, K = (ci/g)*kh*kw, N = co/g per group;
+* **dgrad**  — the transposed conv contracts over the OUTPUT channels.
+  The honest ceiling is the stride-phase decomposition (s_h*s_w phase
+  convs, each M = n*oh*ow, K = (co/g)*ceil(kh/s)*ceil(kw/s), N = ci/g:
+  zero-free, reachable by a phase-split kernel).  XLA's actual lowering
+  dilates dy with stride zeros and pays the full K = (co/g)*kh*kw at
+  M = n*h*w — reported as ``xla est`` next to the ceiling;
+* **wgrad**  — contracts over M = n*oh*ow with output (co/g,
+  (ci/g)*kh*kw): M_pad = co/g -> 8, N_pad = (ci/g)*kh*kw -> 128,
+  K = n*oh*ow -> 128.  Under ``fast_wgrad = s2d`` the strided small-cin
+  convs instead run the dense stride-1 geometry (cin*s_h*s_w channels,
+  ceil(k/s) kernel) — both geometries are printed for those convs.
+
+Elementwise/pool/LRN ops are HBM floors (bytes moved at peak bandwidth,
+assuming XLA fuses pure elementwise chains into neighbors — relu and
+bias ride along with convs for free).  Optimizer traffic: ~20 B/param
+(bf16 grad read, f32 master+momentum read/write, bf16 weight write).
+
+Usage: python experiments/roofline_v2.py [alexnet|googlenet|vgg16] [batch]
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+PEAK_MACS = 197e12 / 2          # bf16 MACs/s on v5e
+HBM_BW = 820e9                  # bytes/s
+BF16 = 2
+
+
+def pad(v, m):
+    return -(-v // m) * m
+
+
+def _padded(M, K, N):
+    """Padded MAC count for one (M,K)x(K,N) matmul: the result tile is
+    8 sublanes x 128 lanes, and either output dim may take either slot —
+    a 48-channel output is 48/128 efficient in lanes but 48/48 in
+    sublanes, so take the better orientation (XLA's layout assignment
+    does)."""
+    return min(pad(M, 8) * pad(N, 128), pad(N, 8) * pad(M, 128)) \
+        * pad(K, 128)
+
+
+def t_mm(g, M, K, N):
+    """Padded-MXU time (s) for g parallel (M,K)x(K,N) matmuls."""
+    return g * _padded(M, K, N) / PEAK_MACS
+
+
+def eff(g, M, K, N):
+    return (M * K * N) / _padded(M, K, N)
+
+
+def analyze(which="alexnet", batch=1024):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from cxxnet_tpu.nnet.net import Network
+    from cxxnet_tpu.nnet.netconfig import NetConfig
+    from cxxnet_tpu.utils.config import parse_config_string
+    from cxxnet_tpu.layers.conv import (ConvolutionLayer, LRNLayer,
+                                        _PoolingBase)
+    from cxxnet_tpu.layers.fullc import FullConnectLayer
+    from cxxnet_tpu.models import googlenet, alexnet, vgg
+
+    conf = {"alexnet": alexnet, "googlenet": googlenet,
+            "vgg16": lambda: vgg(depth=16)}[which]()
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(conf))
+    net = Network(cfg, batch)
+
+    rows = []           # (name, phase, eff, ceil_ms, note)
+    t_total = 0.0       # ceiling step time
+    t_hbm = 0.0
+    flops_model = 0.0   # fwd-pass model flops (2*macs)
+    n_params = 0
+
+    for conn in net.connections:
+        l = conn.layer
+        out = net.node_shapes[conn.nindex_out[0]]
+        inp = net.node_shapes[conn.nindex_in[0]]
+        name = (conn.param_key.split("-", 1)[1] if conn.owns_params
+                else l.type_names[0])
+        if isinstance(l, ConvolutionLayer):
+            n, co, oh, ow = out
+            ci = inp[1]
+            h, w = inp[2], inp[3]
+            g = l.param.num_group
+            kh, kw = l.param.kernel_height, l.param.kernel_width
+            s = l.param.stride
+            n_params += (ci // g) * kh * kw * co + co
+            macs = g * (n * oh * ow) * ((ci // g) * kh * kw) * (co // g)
+            flops_model += 2 * macs
+
+            tf = t_mm(g, n * oh * ow, (ci // g) * kh * kw, co // g)
+            rows.append((name, "fwd",
+                         eff(g, n * oh * ow, (ci // g) * kh * kw, co // g),
+                         tf * 1e3, ""))
+            t_total += tf
+
+            first = conn.nindex_in[0] == 0
+            if not first:
+                # dgrad ceiling: stride-phase decomposition (zero-free)
+                kph, kpw = -(-kh // s), -(-kw // s)
+                td = s * s * t_mm(g, n * oh * ow, (co // g) * kph * kpw,
+                                  ci // g)
+                # XLA's dilated-dy estimate for comparison
+                td_xla = t_mm(g, n * h * w, (co // g) * kh * kw, ci // g)
+                rows.append((name, "dgrad",
+                             eff(g, n * oh * ow,
+                                 (co // g) * kph * kpw, ci // g),
+                             td * 1e3,
+                             f"xla est {td_xla*1e3:.2f}"))
+                t_total += td
+            # wgrad: contraction over n*oh*ow
+            tw = t_mm(g, co // g, n * oh * ow, (ci // g) * kh * kw)
+            note = ""
+            if s > 1 and ci <= 4 and g == 1:
+                # fast_wgrad = s2d geometry (what actually runs)
+                tw2 = t_mm(1, co, n * oh * ow, ci * s * s * kph_kpw(kh, s)
+                           * kph_kpw(kw, s))
+                note = f"s2d geom {tw2*1e3:.2f}"
+            rows.append((name, "wgrad",
+                         eff(g, co // g, n * oh * ow, (ci // g) * kh * kw),
+                         tw * 1e3, note))
+            t_total += tw
+        elif isinstance(l, FullConnectLayer):
+            n = inp[0]
+            K = int(np.prod(inp[1:]))
+            N = l.param.num_hidden
+            n_params += K * N + N
+            flops_model += 2 * n * K * N
+            tf = t_mm(1, n, K, N)
+            td = t_mm(1, n, N, K)
+            tw = t_mm(1, K, n, N)
+            rows.append((name, "fwd", eff(1, n, K, N), tf * 1e3, ""))
+            rows.append((name, "dgrad", eff(1, n, N, K), td * 1e3, ""))
+            rows.append((name, "wgrad", eff(1, K, n, N), tw * 1e3, ""))
+            t_total += tf + td + tw
+        elif isinstance(l, _PoolingBase):
+            bx, by = BF16 * np.prod(inp), BF16 * np.prod(out)
+            tf = (bx + by) / HBM_BW
+            tb = (2 * bx + 2 * by) / HBM_BW  # read x,y,dy write dx
+            rows.append((name, "fwd", 0.0, tf * 1e3, "hbm"))
+            rows.append((name, "bwd", 0.0, tb * 1e3, "hbm"))
+            t_hbm += tf + tb
+        elif isinstance(l, LRNLayer):
+            bx = BF16 * np.prod(inp)
+            tf = 2 * bx / HBM_BW
+            tb = 4 * bx / HBM_BW
+            rows.append((name, "fwd", 0.0, tf * 1e3, "hbm"))
+            rows.append((name, "bwd", 0.0, tb * 1e3, "hbm"))
+            t_hbm += tf + tb
+        # relu/bias/dropout/flatten: assumed fused into neighbors (free)
+
+    t_opt = 20.0 * n_params / HBM_BW
+    t_step = t_total + t_hbm + t_opt
+    mfu = 3.0 * flops_model / (t_step * 2 * PEAK_MACS)
+    print(f"{which} b{batch}: {n_params/1e6:.1f}M params, "
+          f"{flops_model/1e9/batch:.2f} GF/img fwd")
+    print(f"{'op':14s} {'phase':6s} {'MXUeff':>7s} {'ceil ms':>8s}  note")
+    for name, phase, e, ms, note in rows:
+        print(f"{name:14s} {phase:6s} {e*100:6.1f}% {ms:8.3f}  {note}")
+    print(f"  matmul ceiling {t_total*1e3:.2f} ms, hbm {t_hbm*1e3:.2f} ms, "
+          f"optimizer {t_opt*1e3:.2f} ms")
+    print(f"  step ceiling {t_step*1e3:.2f} ms -> MFU ceiling "
+          f"{mfu*100:.1f}% (3x-fwd model-flops convention)")
+
+
+def kph_kpw(k, s):
+    return -(-k // s)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "alexnet"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    analyze(which, batch)
