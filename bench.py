@@ -165,18 +165,26 @@ def main() -> None:
     batch = 1024  # measured +3% imgs/sec over 512 on v5e
     scan_len = 10
     trials = 3
+    # input_s2d = 1: the input pipeline delivers space-to-depth batches,
+    # so conv1 runs as the dense stride-1 conv (same-session A/B device
+    # trace: 46.57 -> 43.45 ms/step, experiments/ab.py round 4)
     t = _make_trainer(ALEXNET_NET, batch, "tpu",
-                      extra=[("dtype", "bfloat16"), ("eval_train", "0")])
+                      extra=[("dtype", "bfloat16"), ("eval_train", "0"),
+                             ("input_s2d", "1")])
     import jax.numpy as jnp
-    # batches generated and staged ON DEVICE in model dtype: this measures
-    # chip compute throughput, not host->device link bandwidth (the input
-    # pipeline overlaps transfers in real training; over a tunneled link
+    # batches generated and staged ON DEVICE in model dtype (and in the
+    # pipeline's s2d delivery shape): this measures chip compute
+    # throughput, not host->device link bandwidth (the input pipeline
+    # overlaps transfers in real training; over a tunneled link
     # host-side generation + transfer of ~6 GB dominated the run).
     # update_many runs scan_len steps per dispatch, amortizing launch
     # latency the way a real input pipeline keeps the device queue full.
     kd, kl = jax.random.split(jax.random.PRNGKey(0))
+    from cxxnet_tpu.ops.nn import s2d_staged_shape
+    s, kh, kw, oh, ow, _, _ = t._s2d_args
+    data_shape = (scan_len, batch) + s2d_staged_shape(3, s, kh, kw, oh, ow)
     datas = jax.jit(lambda k: jax.random.uniform(
-        k, (scan_len, batch, 3, 227, 227), jnp.float32
+        k, data_shape, jnp.float32
     ).astype(jnp.bfloat16))(kd)
     labels = jax.jit(lambda k: jax.random.randint(
         k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)

@@ -34,6 +34,10 @@ class ConvolutionLayer(Layer):
     def __init__(self):
         super().__init__()
         self.space_to_depth = 0
+        # set by the trainer under ``input_s2d = 1``: the batch arrives
+        # pre-transformed to space-to-depth layout (staged once, outside
+        # the step), so forward runs the dense stride-1 conv
+        self.s2d_input = 0
 
     def set_param(self, name: str, val: str) -> None:
         if name == "space_to_depth":
@@ -73,6 +77,12 @@ class ConvolutionLayer(Layer):
         self.check_n_inputs(inputs, 1)
         p = self.param
         x = inputs[0]
+        if self.s2d_input:
+            out = N.conv2d_pres2d(x, params["wmat"], stride=p.stride)
+            if "bias" in params:
+                out = out + params["bias"].astype(out.dtype).reshape(
+                    1, -1, 1, 1)
+            return [out], buffers
         if ("bias" in params and not self.space_to_depth
                 and N.use_fast_wgrad(x.shape[1], p.stride, p.num_group)):
             out = N.conv_bias_fast(x, params["wmat"], params["bias"],

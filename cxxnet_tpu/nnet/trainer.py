@@ -73,6 +73,14 @@ class NetTrainer:
         # the subtract/multiply fuses into conv1)
         self.input_scale = 1.0
         self.input_mean: Optional[np.ndarray] = None
+        # input_s2d = 1: transform batches to space-to-depth layout ONCE
+        # at staging (outside the jitted step) and run the first conv as
+        # the dense stride-1 conv it becomes -- removes the small-cin/
+        # large-stride MXU starvation from the step entirely (conv1
+        # fwd+wgrad 7.0 ms vs 2.3 ceiling, BASELINE.md round-4 table)
+        self.input_s2d = 0
+        self._s2d_args = None
+        self._s2d_fns = {}
         # remat = K: partition the graph body into K segments (at the same
         # single-activation cut points pipeline parallelism uses) and wrap
         # each in jax.checkpoint — backward recomputes segment activations
@@ -141,6 +149,8 @@ class NetTrainer:
             self.eval_train = int(val)
         elif name == "eval_group":
             self.eval_group = int(val)
+        elif name == "input_s2d":
+            self.input_s2d = int(val)
         elif name == "print_step":
             self.print_step = int(val)
         elif name.startswith("metric"):
@@ -234,6 +244,7 @@ class NetTrainer:
         self.loss_scale = 1.0 / (self.batch_size * self.update_period)
         self._label_fields = self.netcfg.label_fields()
         self._make_shardings()
+        self._setup_input_s2d()
         self._train_step = self._build_train_step()
         self._multi_step_cache: Dict[int, Any] = {}
         self._eval_step_cache = {}
@@ -307,6 +318,69 @@ class NetTrainer:
         self.buffers = jax.device_put(self.buffers, self.buffer_shardings)
 
     # ----------------------------------------------------------- step build
+    def _setup_input_s2d(self):
+        """Wire ``input_s2d = 1``: flag the first conv to consume
+        space-to-depth input and record the staging-transform geometry."""
+        self._s2d_args = None
+        self._s2d_fns = {}
+        if not self.input_s2d:
+            return
+        from ..layers.conv import ConvolutionLayer
+        consumers = [c for c in self.net.connections if 0 in c.nindex_in]
+        assert len(consumers) == 1, \
+            "input_s2d: the data node must feed exactly one layer"
+        l = consumers[0].layer
+        p = getattr(l, "param", None)
+        assert (isinstance(l, ConvolutionLayer) and p.stride > 1
+                and p.num_group == 1 and not l.space_to_depth), (
+            "input_s2d: the first layer must be an ungrouped strided conv")
+        _, c, h, w = self.net.node_shapes[0]
+        from ..ops import nn as N_ops
+        oh = N_ops.conv_out_size(h, p.kernel_height, p.stride, p.pad_y)
+        ow = N_ops.conv_out_size(w, p.kernel_width, p.stride, p.pad_x)
+        l.s2d_input = 1
+        self._s2d_args = (p.stride, p.kernel_height, p.kernel_width,
+                          oh, ow, p.pad_y, p.pad_x)
+
+    def _s2d_transform(self, data, stacked=False):
+        """Space-to-depth the staged batch on device, once, outside the
+        step.  u8 batches are normalized first (conv padding must pad the
+        NORMALIZED zeros, as the in-step path does), so the step sees
+        ready-to-convolve f32 data either way.
+
+        When the input pipeline already delivers s2d-shaped batches (the
+        host iterators under ``input_s2d = 1``, or bench data generated
+        in s2d shape), this is a no-op: the device-side transform is a
+        fallback, and a measured-slow one (a (b,3,227,227) bf16
+        relayout-transpose runs ~5x off the HBM floor, 4.0 ms/step on
+        the b1024 stack — device trace, round 4)."""
+        if self._s2d_args is None:
+            return data
+        cdim = data.shape[2] if stacked else data.shape[1]
+        s, _, _, _, _, py, px = self._s2d_args
+        _, c_in, _, _ = self.net.node_shapes[0]
+        if cdim == c_in * s * s:
+            # input pipeline already delivered s2d
+            assert not (data.dtype == jnp.uint8 and (py or px)), (
+                "input_s2d: pre-s2d u8 delivery is unsupported for a "
+                "padded first conv — u8 can only encode padding as raw "
+                "0, which normalizes to (0-mean)*scale instead of the "
+                "zeros the reference path pads with; deliver plain u8 "
+                "batches (the staging transform normalizes before "
+                "padding) or pre-normalized f32")
+            return data
+        key = (stacked, str(data.dtype), data.shape)
+        if key not in self._s2d_fns:
+            from ..ops import nn as N_ops
+            s, kh, kw, oh, ow, py, px = self._s2d_args
+
+            def f(x):
+                x = self._normalize_input(x)
+                xb, _, _ = N_ops.s2d_input(x, s, kh, kw, oh, ow, py, px)
+                return xb
+            self._s2d_fns[key] = jax.jit(jax.vmap(f) if stacked else f)
+        return self._s2d_fns[key](data)
+
     def _normalize_input(self, data):
         """Device-side normalization of raw u8 batches (output_u8=1):
         (x - mean_value[c]) * scale, matching the host iterators' SetData
@@ -315,7 +389,13 @@ class NetTrainer:
             return data
         x = data.astype(jnp.float32)
         if self.input_mean is not None:
-            x = x - jnp.asarray(self.input_mean).reshape(1, -1, 1, 1)
+            mean = jnp.asarray(self.input_mean)
+            if self._s2d_args is not None \
+                    and x.shape[-3] == mean.size * self._s2d_args[0] ** 2:
+                # u8 batch delivered pre-s2d by the input pipeline: the
+                # per-channel mean expands over the (c, sy, sx) order
+                mean = jnp.repeat(mean, self._s2d_args[0] ** 2)
+            x = x - mean.reshape(1, -1, 1, 1)
         if self.input_scale != 1.0:
             x = x * self.input_scale
         return x
@@ -673,7 +753,8 @@ class NetTrainer:
         node id -> (k, batch, width) stacked outputs for train-metric
         accumulation.
         """
-        datas = self._device_stacked(datas)
+        datas = self._s2d_transform(self._device_stacked(datas),
+                                    stacked=True)
         labels = self._device_stacked(labels, jnp.float32)
         k = datas.shape[0]
         fn = self._build_multi_step(k, with_outs)
@@ -770,7 +851,7 @@ class NetTrainer:
         if do_update:
             self.epoch_counter += 1
         rng = jax.random.fold_in(self._rng_base, self.sample_counter)
-        data = self._device_batch(batch.data)
+        data = self._s2d_transform(self._device_batch(batch.data))
         label_vec = self._device_batch(batch.label, jnp.float32)
         extras = tuple(self._device_batch(e) for e in batch.extra_data)
         # tail-batch padding: real instances train, padded replicas are
@@ -845,14 +926,16 @@ class NetTrainer:
                 estep = self._get_eval_step(node_ids)
                 b = group[0]
                 outs = estep(self.params, self.buffers,
-                             self._device_batch(b.data),
+                             self._s2d_transform(
+                                 self._device_batch(b.data)),
                              tuple(self._device_batch(e)
                                    for e in b.extra_data))
                 outs = {nid: np.asarray(v)[None] for nid, v in outs.items()}
             else:
                 fn = self._build_eval_many(len(group), node_ids)
-                datas = self._device_stacked(
-                    np.stack([b.data for b in group]))
+                datas = self._s2d_transform(
+                    self._device_stacked(np.stack([b.data for b in group])),
+                    stacked=True)
                 outs = jax.tree.map(np.asarray,
                                     fn(self.params, self.buffers, datas))
             for i, b in enumerate(group):
@@ -901,7 +984,7 @@ class NetTrainer:
         nid = self.net.final_node
         estep = self._get_eval_step((nid,))
         outs = estep(self.params, self.buffers,
-                     self._device_batch(batch.data),
+                     self._s2d_transform(self._device_batch(batch.data)),
                      tuple(self._device_batch(e) for e in batch.extra_data))
         n_valid = batch.batch_size - batch.num_batch_padd
         return np.asarray(outs[nid])[:n_valid]
@@ -910,7 +993,7 @@ class NetTrainer:
         nid = self.net.node_id(node_name)
         estep = self._get_eval_step((nid,))
         outs = estep(self.params, self.buffers,
-                     self._device_batch(batch.data),
+                     self._s2d_transform(self._device_batch(batch.data)),
                      tuple(self._device_batch(e) for e in batch.extra_data))
         n_valid = batch.batch_size - batch.num_batch_padd
         return np.asarray(outs[nid])[:n_valid]

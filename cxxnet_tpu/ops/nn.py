@@ -111,19 +111,51 @@ def conv2d_s2d(x: jnp.ndarray, w: jnp.ndarray, *, stride: int,
     layer math.
     """
     s = stride
-    co, ci, kh, kw = w.shape
+    ci = w.shape[1]
     assert ci == x.shape[1], "conv2d_s2d: grouped conv not supported"
-    oh = conv_out_size(x.shape[2], kh, s, pad_y)
-    ow = conv_out_size(x.shape[3], kw, s, pad_x)
-    xb, kb_y, kb_x = s2d_input(x, s, kh, kw, oh, ow, pad_y, pad_x)
+    oh = conv_out_size(x.shape[2], w.shape[2], s, pad_y)
+    ow = conv_out_size(x.shape[3], w.shape[3], s, pad_x)
+    xb, _, _ = s2d_input(x, s, w.shape[2], w.shape[3], oh, ow, pad_y, pad_x)
+    return conv2d_pres2d(xb, w, stride=s)
+
+
+def s2d_weights(w: jnp.ndarray, s: int) -> jnp.ndarray:
+    """(co, ci, kh, kw) -> the dense stride-1 weights (co, ci*s*s, kb_y,
+    kb_x) matching ``s2d_input``'s (c, sy, sx) channel order."""
+    co, ci, kh, kw = w.shape
+    kb_y, kb_x = -(-kh // s), -(-kw // s)
     wp = jnp.pad(w, ((0, 0), (0, 0),
                      (0, kb_y * s - kh), (0, kb_x * s - kw)))
     wb_ = wp.reshape(co, ci, kb_y, s, kb_x, s)
-    wb_ = wb_.transpose(0, 1, 3, 5, 2, 4).reshape(co, ci * s * s, kb_y, kb_x)
+    return wb_.transpose(0, 1, 3, 5, 2, 4).reshape(co, ci * s * s,
+                                                   kb_y, kb_x)
+
+
+def conv2d_pres2d(xb: jnp.ndarray, w: jnp.ndarray, *,
+                  stride: int) -> jnp.ndarray:
+    """Convolution on an input ALREADY in space-to-depth layout (the
+    input-boundary staging path: the batch was transformed once at
+    staging, so the step only pays the dense stride-1 conv — and its
+    wgrad contracts directly against the staged s2d activation, the
+    geometry XLA's dilated wgrad starves on; BASELINE.md round-4 per-op
+    table).  ``w`` stays in canonical (co, ci, kh, kw) form — the tiny
+    weight-side rearrangement (35 KB for AlexNet conv1) runs in-step and
+    autodiff transposes it back, so checkpoints and get/set_weight keep
+    the reference layout."""
     return lax.conv_general_dilated(
-        xb, wb_.astype(xb.dtype), window_strides=(1, 1),
+        xb, s2d_weights(w, stride).astype(xb.dtype), window_strides=(1, 1),
         padding=((0, 0), (0, 0)),
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def s2d_staged_shape(c: int, stride: int, kh: int, kw: int,
+                     oh: int, ow: int) -> Tuple[int, int, int]:
+    """Per-image (c', hb, wb) shape of a batch staged by ``s2d_input`` —
+    the delivery shape of the ``input_s2d`` pipeline contract (benches
+    and host iterators must produce exactly this)."""
+    s = stride
+    kb_y, kb_x = -(-kh // s), -(-kw // s)
+    return (c * s * s, oh - 1 + kb_y, ow - 1 + kb_x)
 
 
 def s2d_input(x: jnp.ndarray, stride: int, kh: int, kw: int,

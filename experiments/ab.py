@@ -1,0 +1,144 @@
+"""Same-session interleaved A/B bench (VERDICT r3 weak 1: chip-session
+variance is ±1.5-2 ms, so only interleaved same-session comparisons at
+matched thermal/scheduling state are meaningful).
+
+Builds one trainer per config variant IN ONE PROCESS, shares the
+device-resident synthetic data, then interleaves measurement repeats
+round-robin.  Reports per-variant median ± spread and the median delta
+vs the first (baseline) variant.
+
+Usage:
+  python experiments/ab.py [batch] [scan_len] [reps] VARIANT [VARIANT...]
+  VARIANT := name[:key=val[,key=val...]]
+e.g.
+  python experiments/ab.py 1024 6 5 base s2d:input_s2d=1
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def _module_ms(tracedir):
+    """Total device ms across ALL XLA modules in a trace — the staging
+    transform (input_s2d) is a separate small module and must count."""
+    import glob
+    import os
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = glob.glob(os.path.join(tracedir, "**", "*.xplane.pb"),
+                      recursive=True)
+    xs = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as f:
+        xs.ParseFromString(f.read())
+    tot = 0.0
+    for plane in xs.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if "XLA Modules" not in line.name:
+                continue
+            for ev in line.events:
+                tot += ev.duration_ps / 1e9
+    return tot
+
+
+def main():
+    args = [a for a in sys.argv[1:]]
+    nums = []
+    while args and args[0].replace(".", "").isdigit():
+        nums.append(int(args[0]))
+        args.pop(0)
+    batch = nums[0] if len(nums) > 0 else 1024
+    scan_len = nums[1] if len(nums) > 1 else 6
+    reps = nums[2] if len(nums) > 2 else 5
+    assert args, "need at least one variant"
+    variants = []
+    for a in args:
+        name, _, kvs = a.partition(":")
+        extra = [tuple(kv.split("=", 1)) for kv in kvs.split(",") if kv]
+        variants.append((name, extra))
+
+    from __graft_entry__ import ALEXNET_NET, _make_trainer
+    from bench import conv_flops_per_image, PEAK_FLOPS
+
+    kd, kl = jax.random.split(jax.random.PRNGKey(0))
+    datas = jax.jit(lambda k: jax.random.uniform(
+        k, (scan_len, batch, 3, 227, 227), jnp.float32
+    ).astype(jnp.bfloat16))(kd)
+    labels = jax.jit(lambda k: jax.random.randint(
+        k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)
+
+    trainers, var_datas = {}, {}
+    for name, extra in variants:
+        t = _make_trainer(ALEXNET_NET, batch, "tpu",
+                          extra=[("dtype", "bfloat16"),
+                                 ("eval_train", "0")] + list(extra))
+        t.start_round(1)
+        d = datas
+        if t._s2d_args is not None:
+            # the input-pipeline contract under input_s2d: batches arrive
+            # s2d-shaped (host iterators emit them; synth data is
+            # generated in that shape) — the device-side transform is a
+            # measured-slow fallback, not the product path
+            from cxxnet_tpu.ops.nn import s2d_staged_shape
+            s, kh, kw, oh, ow, _, _ = t._s2d_args
+            shp = (scan_len, batch) + s2d_staged_shape(3, s, kh, kw, oh, ow)
+            d = jax.jit(lambda k: jax.random.uniform(
+                k, shp, jnp.float32).astype(jnp.bfloat16))(kd)
+        var_datas[name] = d
+        c0 = time.perf_counter()
+        np.asarray(t.update_many(d, labels))  # compile+warm
+        print(f"{name}: compile+warm {time.perf_counter()-c0:.1f}s",
+              file=sys.stderr, flush=True)
+        trainers[name] = t
+
+    times = {name: [] for name, _ in variants}
+    dev_times = {name: [] for name, _ in variants}
+    for r in range(reps):
+        for name, _ in variants:
+            t = trainers[name]
+            t0 = time.perf_counter()
+            losses = t.update_many(var_datas[name], labels)
+            np.asarray(losses)
+            times[name].append((time.perf_counter() - t0) / scan_len * 1e3)
+    # device-time pass: wall over the tunnel carries +-10 ms dispatch
+    # jitter, so the decisive number is the on-chip module time from a
+    # trace (2 traced dispatches per variant, interleaved)
+    for r in range(2):
+        for name, _ in variants:
+            t = trainers[name]
+            tdir = f"/tmp/ab_prof/{name}_{r}"
+            import os
+            os.system(f"rm -rf {tdir}")
+            jax.profiler.start_trace(tdir)
+            np.asarray(t.update_many(var_datas[name], labels))
+            jax.profiler.stop_trace()
+            dev_times[name].append(_module_ms(tdir) / scan_len)
+
+    flops_fwd = conv_flops_per_image(trainers[variants[0][0]].net)
+    dev = jax.devices()[0].device_kind
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev), 197e12)
+    base_med = base_dev = None
+    for name, _ in variants:
+        ts = sorted(times[name])
+        med = ts[len(ts) // 2]
+        dts = sorted(dev_times[name])
+        dev_ms = dts[0]
+        mfu = 3.0 * flops_fwd * batch / (dev_ms / 1e3) / peak
+        delta = "" if base_med is None else (
+            f"  wallΔ {med - base_med:+.2f}  devΔ {dev_ms - base_dev:+.2f}")
+        if base_med is None:
+            base_med, base_dev = med, dev_ms
+        print(f"{name:12s} wall median {med:6.2f} [{ts[0]:.2f}..{ts[-1]:.2f}]"
+              f"  device {dev_ms:6.2f} ms/step ({dts[-1]:.2f})  "
+              f"MFU(dev) {mfu*100:.1f}%{delta}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
