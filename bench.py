@@ -30,14 +30,17 @@ def peak_flops(device_kind: str) -> float:
                 197e12)
 
 
-def baseline_json(imgs_per_sec: float) -> dict:
+def baseline_json(imgs_per_sec: float, extra: dict = None) -> dict:
     """The one-line payload the driver parses from stdout."""
-    return {
+    out = {
         "metric": "alexnet_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "imgs/sec",
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
     }
+    if extra:
+        out.update(extra)
+    return out
 
 
 def conv_flops_per_image(net) -> float:
@@ -59,6 +62,28 @@ def conv_flops_per_image(net) -> float:
     return total
 
 
+def _trace_device_ms(tracedir: str) -> float:
+    """Total on-chip XLA-module time in a trace (all modules)."""
+    import glob
+    import os
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = glob.glob(os.path.join(tracedir, "**", "*.xplane.pb"),
+                      recursive=True)
+    xs = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as f:
+        xs.ParseFromString(f.read())
+    tot = 0.0
+    for plane in xs.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if "XLA Modules" not in line.name:
+                continue
+            for ev in line.events:
+                tot += ev.duration_ps / 1e9
+    return tot
+
+
 def bench_lenet() -> float:
     """Secondary BASELINE metric: MNIST LeNet step time (ms)."""
     import jax.numpy as jnp
@@ -75,9 +100,14 @@ def bench_lenet() -> float:
         rnd.randint(0, 10, (scan_len, batch, 1)).astype(np.float32))
     t.start_round(1)
     np.asarray(t.update_many(datas, labels))  # warmup / compile
-    t0 = time.perf_counter()
-    np.asarray(t.update_many(datas, labels))
-    return (time.perf_counter() - t0) / scan_len * 1000.0
+    # median of 5: at ~5 ms/step the tunneled dispatch latency dominates
+    # single readings (the round-3 "regression" 4.35 -> 4.96 ms was this)
+    ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(t.update_many(datas, labels))
+        ms.append((time.perf_counter() - t0) / scan_len * 1000.0)
+    return sorted(ms)[2]
 
 
 def bench_vgg():
@@ -125,35 +155,44 @@ def transformer_flops_per_token(vocab: int, seq: int, dim: int,
 
 
 def bench_transformer() -> float:
-    """Long-context secondary metric: transformer LM step time (flash
-    attention path), tokens/sec on one chip."""
+    """Long-context secondary metric: transformer LM at model scale —
+    d2048, 12 layers, s4096, flash attention, adam (round-3's d512/4L
+    config measured kernel overheads, not a model; VERDICT r3 item 6).
+    Returns tokens/sec on one chip; MFU is the cross-config metric."""
     import jax.numpy as jnp
     from cxxnet_tpu.models import transformer
     from __graft_entry__ import _make_trainer
-    vocab, seq, batch, scan_len = 512, 4096, 16, 4  # b2->16: +49% tok/s
+    vocab, seq, dim, nlayer = 8192, 4096, 2048, 12
+    batch, scan_len = 4, 4  # b6/L16 exceed HBM at this width
     t = _make_trainer(
-        transformer(vocab=vocab, seq=seq, dim=512, nlayer=4, nhead=8),
+        transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nlayer,
+                    nhead=dim // 64),
         batch, "tpu", extra=[("dtype", "bfloat16"), ("updater", "adam"),
                              ("eval_train", "0"), ("silent", "1")])
-    rnd = np.random.RandomState(0)
-    toks = rnd.randint(0, vocab, (scan_len, batch, 1, 1, seq))
-    datas = jnp.asarray(toks.astype(np.float32))
+    import jax
+    kd = jax.random.PRNGKey(0)
+    # generated on device: token transfer is irrelevant to the metric
+    toks = jax.jit(lambda k: jax.random.randint(
+        k, (scan_len, batch, 1, 1, seq), 0, vocab
+    ).astype(jnp.float32))(kd)
     # next-token objective: position t is scored against token t+1 (the
     # last position wraps to token 0 — irrelevant for random-token
     # throughput, do not reuse for perplexity)
-    labels = jnp.asarray(np.roll(toks, -1, axis=-1)
-                         .reshape(scan_len, batch, seq).astype(np.float32))
+    labels = jax.jit(lambda a: jnp.roll(a, -1, axis=-1).reshape(
+        scan_len, batch, seq))(toks)
     t.start_round(1)
-    np.asarray(t.update_many(datas, labels))  # warmup / compile
-    t0 = time.perf_counter()
-    np.asarray(t.update_many(datas, labels))
-    dt = (time.perf_counter() - t0) / scan_len
+    np.asarray(t.update_many(toks, labels))  # warmup / compile
+    ms = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(t.update_many(toks, labels))
+        ms.append((time.perf_counter() - t0) / scan_len)
+    dt = sorted(ms)[1]
     tok_s = batch * seq / dt
-    import jax
-    f_tok = transformer_flops_per_token(vocab, seq, 512, 4)
+    f_tok = transformer_flops_per_token(vocab, seq, dim, nlayer)
     mfu = 3.0 * f_tok * tok_s / peak_flops(jax.devices()[0].device_kind)
-    print(f"bench: transformer MFU={mfu * 100:.1f}% "
-          f"(fwd {f_tok / 1e6:.1f} MFLOPs/token, b{batch})",
+    print(f"bench: transformer d{dim} L{nlayer} MFU={mfu * 100:.1f}% "
+          f"(fwd {f_tok / 1e6:.0f} MFLOPs/token, b{batch})",
           file=sys.stderr)
     return tok_s
 
@@ -164,7 +203,7 @@ def main() -> None:
 
     batch = 1024  # measured +3% imgs/sec over 512 on v5e
     scan_len = 10
-    trials = 3
+    trials = 5
     # input_s2d = 1: the input pipeline delivers space-to-depth batches,
     # so conv1 runs as the dense stride-1 conv (same-session A/B device
     # trace: 46.57 -> 43.45 ms/step, experiments/ab.py round 4)
@@ -190,14 +229,18 @@ def main() -> None:
         k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)
     t.start_round(1)
     np.asarray(t.update_many(datas, labels))  # warmup / compile
-    t0 = time.perf_counter()
+    # variance discipline (VERDICT r3 weak 1): per-trial timings, median
+    # + spread in the JSON — chip-session/tunnel noise is ±1.5-2 ms, so
+    # a single aggregate reading overstates round-over-round deltas
+    trial_ms = []
     for _ in range(trials):
+        t0 = time.perf_counter()
         losses = t.update_many(datas, labels)
-    np.asarray(losses)  # sync
-    dt = time.perf_counter() - t0
-    steps = trials * scan_len
-    imgs_per_sec = batch * steps / dt
-    step_ms = dt / steps * 1000.0
+        np.asarray(losses)  # sync
+        trial_ms.append((time.perf_counter() - t0) / scan_len * 1000.0)
+    ts = sorted(trial_ms)
+    step_ms = ts[len(ts) // 2]
+    imgs_per_sec = batch / (step_ms / 1e3)
 
     flops_fwd = conv_flops_per_image(t.net)
     train_flops = 3.0 * flops_fwd * imgs_per_sec
@@ -205,8 +248,33 @@ def main() -> None:
     peak = peak_flops(dev_kind)
     mfu = train_flops / peak
     print(f"bench: AlexNet b{batch} step={step_ms:.1f}ms "
+          f"[{ts[0]:.1f}..{ts[-1]:.1f}] "
           f"imgs/sec={imgs_per_sec:.1f} fwd_gflops/img={flops_fwd / 1e9:.2f} "
           f"device={dev_kind} MFU={mfu * 100:.1f}%", file=sys.stderr)
+    spread = {"step_ms_median": round(step_ms, 2),
+              "step_ms_min": round(ts[0], 2),
+              "step_ms_max": round(ts[-1], 2),
+              "trials": len(ts)}
+    # device time from a trace: wall carries per-dispatch tunnel latency
+    # that varies 3-10 ms/step BETWEEN sessions (tight within a session),
+    # so the on-chip number is the comparable one across rounds
+    try:
+        import shutil
+        tdir = "/tmp/bench_prof"
+        shutil.rmtree(tdir, ignore_errors=True)
+        jax.profiler.start_trace(tdir)
+        try:
+            np.asarray(t.update_many(datas, labels))
+        finally:
+            jax.profiler.stop_trace()
+        dev_ms = _trace_device_ms(tdir) / scan_len
+        spread["device_step_ms"] = round(dev_ms, 2)
+        dev_mfu = 3.0 * flops_fwd * batch / (dev_ms / 1e3) / peak
+        spread["device_mfu_pct"] = round(dev_mfu * 100, 1)
+        print(f"bench: AlexNet device {dev_ms:.2f} ms/step "
+              f"MFU(dev)={dev_mfu * 100:.1f}%", file=sys.stderr)
+    except Exception as e:  # tracing must never break the headline
+        print(f"bench: device-time trace failed: {e}", file=sys.stderr)
     del t, datas, labels, losses  # free HBM before the secondary benches
     try:
         lenet_ms = bench_lenet()
@@ -228,7 +296,7 @@ def main() -> None:
               file=sys.stderr)
     except Exception as e:
         print(f"bench: VGG secondary metric failed: {e}", file=sys.stderr)
-    print(json.dumps(baseline_json(imgs_per_sec)))
+    print(json.dumps(baseline_json(imgs_per_sec, spread)))
 
 
 if __name__ == "__main__":

@@ -46,6 +46,7 @@ _DEFS = {
     "conv1_fwd": ("CXXNET_CONV1_FWD", "conv", ("conv", "s2d")),
     "pallas_lrn": ("CXXNET_PALLAS_LRN", "hwcn", ("hwcn", "1", "0")),
     "relu_vjp": ("CXXNET_RELU_VJP", "out", ("out", "xla")),
+    "pool_relu_reorder": ("CXXNET_POOL_RELU_REORDER", "1", ("1", "0")),
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),
 }
 

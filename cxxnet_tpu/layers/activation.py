@@ -60,8 +60,22 @@ def _relu_bwd(out, dy):
 _relu_out_grad.defvjp(_relu_fwd, _relu_bwd)
 
 
+def apply_relu(x: jnp.ndarray) -> jnp.ndarray:
+    """relu under the configured VJP formulation (see ReluLayer)."""
+    if opts.relu_vjp == "xla":
+        return jnp.maximum(x, 0)
+    return _relu_out_grad(x)
+
+
 class ReluLayer(_UnaryLayer):
     type_names = ("relu",)
+
+    # set by the trainer's relu->max_pool reorder (engine option
+    # pool_relu_reorder): max pooling commutes with relu, so the relu
+    # moves AFTER the pool — this layer passes through and the pool
+    # applies it on the (stride^2-smaller) pooled tensor, eliminating a
+    # full-size relu-backward HBM pass
+    defer_to_pool = False
 
     def _fn(self, x, ctx):
         # Gradient masked from the OUTPUT (reference op.h relu_grad uses the
@@ -69,9 +83,9 @@ class ReluLayer(_UnaryLayer):
         # pre-activation, which forces XLA to keep BOTH conv-out and
         # relu-out alive to the backward pass — an extra full-activation
         # HBM write per conv+relu pair (~1.3 GB/step on AlexNet b1024).
-        if opts.relu_vjp == "xla":
-            return jnp.maximum(x, 0)
-        return _relu_out_grad(x)
+        if self.defer_to_pool:
+            return x
+        return apply_relu(x)
 
 
 class SigmoidLayer(_UnaryLayer):

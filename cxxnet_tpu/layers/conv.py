@@ -124,22 +124,36 @@ class _PoolingBase(Layer):
 class MaxPoolingLayer(_PoolingBase):
     type_names = ("max_pooling",)
 
+    # counterpart of ReluLayer.defer_to_pool (the relu->pool reorder):
+    # apply the deferred relu to the pooled output — max(relu(x)) ==
+    # relu(max(x)) (relu is monotone; -inf pool padding is excluded
+    # either way), and gradients agree a.e. (argmax ties that differ
+    # all receive zero gradient through the relu mask)
+    relu_after = False
+
     def forward(self, params, buffers, inputs, ctx):
         p = self.param
-        return [N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
-                             p.stride, p.pad_y, p.pad_x)], buffers
+        out = N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
+                           p.stride, p.pad_y, p.pad_x)
+        if self.relu_after:
+            from .activation import apply_relu
+            out = apply_relu(out)
+        return [out], buffers
 
 
 class ReluMaxPoolingLayer(_PoolingBase):
-    """relu fused into max pooling (layer_impl-inl.hpp:55-56)."""
+    """relu fused into max pooling (layer_impl-inl.hpp:55-56).  Computed
+    as relu(pool(x)) — same math (max commutes with relu), but the relu
+    runs on the stride^2-smaller pooled tensor."""
 
     type_names = ("relu_max_pooling",)
 
     def forward(self, params, buffers, inputs, ctx):
+        from .activation import apply_relu
         p = self.param
-        x = jax.nn.relu(inputs[0])
-        return [N.max_pool2d(x, p.kernel_height, p.kernel_width, p.stride,
-                             p.pad_y, p.pad_x)], buffers
+        x = N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
+                         p.stride, p.pad_y, p.pad_x)
+        return [apply_relu(x)], buffers
 
 
 class SumPoolingLayer(_PoolingBase):

@@ -244,6 +244,7 @@ class NetTrainer:
         self.loss_scale = 1.0 / (self.batch_size * self.update_period)
         self._label_fields = self.netcfg.label_fields()
         self._make_shardings()
+        self._reorder_relu_pool()
         self._setup_input_s2d()
         self._train_step = self._build_train_step()
         self._multi_step_cache: Dict[int, Any] = {}
@@ -318,6 +319,49 @@ class NetTrainer:
         self.buffers = jax.device_put(self.buffers, self.buffer_shardings)
 
     # ----------------------------------------------------------- step build
+    def _reorder_relu_pool(self):
+        """Peephole: relu feeding a max pool moves AFTER the pool
+        (max(relu(x)) == relu(max(x)); gradients agree a.e. — differing
+        argmax ties all get zero gradient through the relu mask).  The
+        relu backward then runs on the stride^2-smaller pooled tensor
+        and the pre-relu activation never needs a second full-size HBM
+        pass.  Skipped when the relu's output node has other consumers,
+        is a train-metric eval node, or the relu is a self-loop (its
+        node would then hold the pre-activation)."""
+        from ..layers.activation import ReluLayer
+        from ..layers.conv import MaxPoolingLayer
+        if engine.opts.pool_relu_reorder != "1":
+            return
+        conns = self.net.connections
+        producer = {}
+        n_consumers: Dict[int, int] = {}
+        layer_uses: Dict[int, int] = {}
+        for c in conns:
+            for n in c.nindex_out:
+                producer[n] = c
+            for n in c.nindex_in:
+                n_consumers[n] = n_consumers.get(n, 0) + 1
+            layer_uses[id(c.layer)] = layer_uses.get(id(c.layer), 0) + 1
+        for c in conns:
+            if not (type(c.layer) is MaxPoolingLayer):
+                continue
+            if layer_uses[id(c.layer)] > 1:
+                # shared layer instance (share[tag] / siamese towers):
+                # flag mutation would leak past this connection's guards
+                continue
+            node = c.nindex_in[0]
+            prod = producer.get(node)
+            if prod is None or type(prod.layer) is not ReluLayer:
+                continue
+            if prod.nindex_in == prod.nindex_out:  # self-loop relu
+                continue
+            if n_consumers.get(node, 0) != 1 or node in self.eval_node_ids:
+                continue
+            if layer_uses[id(prod.layer)] > 1:
+                continue
+            prod.layer.defer_to_pool = True
+            c.layer.relu_after = True
+
     def _setup_input_s2d(self):
         """Wire ``input_s2d = 1``: flag the first conv to consume
         space-to-depth input and record the staging-transform geometry."""

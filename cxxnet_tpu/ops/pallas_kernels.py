@@ -294,6 +294,12 @@ def _lrn_hwcn_bwd_kernel_u(x_ref, g_ref, dx_ref, *, nsize, salpha, beta,
     dx = g * npow - (2.0 * beta * salpha) * x * wsum
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
+# per-program VMEM budget for the LRN block planner: the round-3
+# "measured-working" 3 MB leaves AlexNet's odd 27-row planes at hb=1
+# (216 tiny programs); raced values recorded in BASELINE.md
+_LRN_BUDGET = 3 << 20
+
+
 def _lrn_hwcn_call(kernel, out_dtype, nsize, salpha, beta, knorm, args,
                    interpret):
     h, w, c, n = args[0].shape
@@ -309,7 +315,7 @@ def _lrn_hwcn_call(kernel, out_dtype, nsize, salpha, beta, knorm, args,
     # temporaries — measured: the AlexNet shapes prefer 2-row untiled
     # blocks, GoogLeNet's 56x56 shapes need the C-tiling)
     cb = c
-    while cb > 2 * halo and w * cb * nb * 4 > (3 << 20):
+    while cb > 2 * halo and w * cb * nb * 4 > _LRN_BUDGET:
         cb //= 2
     while c % cb:
         cb -= 1
@@ -324,7 +330,7 @@ def _lrn_hwcn_call(kernel, out_dtype, nsize, salpha, beta, knorm, args,
         kernel = {_lrn_hwcn_fwd_kernel: _lrn_hwcn_fwd_kernel_u,
                   _lrn_hwcn_bwd_kernel: _lrn_hwcn_bwd_kernel_u}[kernel]
     plane = w * (cb + 2 * halo) * nb * 4
-    hb = max(1, (3 << 20) // max(plane, 1))
+    hb = max(1, _LRN_BUDGET // max(plane, 1))
     while h % hb:
         hb -= 1
     kern = functools.partial(kernel, nsize=nsize, salpha=salpha, beta=beta,

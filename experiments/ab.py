@@ -24,29 +24,6 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
-def _module_ms(tracedir):
-    """Total device ms across ALL XLA modules in a trace — the staging
-    transform (input_s2d) is a separate small module and must count."""
-    import glob
-    import os
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    paths = glob.glob(os.path.join(tracedir, "**", "*.xplane.pb"),
-                      recursive=True)
-    xs = xplane_pb2.XSpace()
-    with open(max(paths, key=os.path.getmtime), "rb") as f:
-        xs.ParseFromString(f.read())
-    tot = 0.0
-    for plane in xs.planes:
-        if "TPU" not in plane.name:
-            continue
-        for line in plane.lines:
-            if "XLA Modules" not in line.name:
-                continue
-            for ev in line.events:
-                tot += ev.duration_ps / 1e9
-    return tot
-
-
 def main():
     args = [a for a in sys.argv[1:]]
     nums = []
@@ -64,7 +41,8 @@ def main():
         variants.append((name, extra))
 
     from __graft_entry__ import ALEXNET_NET, _make_trainer
-    from bench import conv_flops_per_image, PEAK_FLOPS
+    from bench import (conv_flops_per_image, PEAK_FLOPS,
+                       _trace_device_ms)
 
     kd, kl = jax.random.split(jax.random.PRNGKey(0))
     datas = jax.jit(lambda k: jax.random.uniform(
@@ -92,7 +70,14 @@ def main():
                 k, shp, jnp.float32).astype(jnp.bfloat16))(kd)
         var_datas[name] = d
         c0 = time.perf_counter()
-        np.asarray(t.update_many(d, labels))  # compile+warm
+        try:
+            np.asarray(t.update_many(d, labels))  # compile+warm
+        except Exception as e:
+            print(f"{name}: FAILED {str(e).splitlines()[0][:120]}",
+                  file=sys.stderr, flush=True)
+            del t
+            var_datas.pop(name, None)  # free the staged batch's HBM
+            continue
         print(f"{name}: compile+warm {time.perf_counter()-c0:.1f}s",
               file=sys.stderr, flush=True)
         trainers[name] = t
@@ -101,6 +86,8 @@ def main():
     dev_times = {name: [] for name, _ in variants}
     for r in range(reps):
         for name, _ in variants:
+            if name not in trainers:
+                continue
             t = trainers[name]
             t0 = time.perf_counter()
             losses = t.update_many(var_datas[name], labels)
@@ -111,6 +98,8 @@ def main():
     # trace (2 traced dispatches per variant, interleaved)
     for r in range(2):
         for name, _ in variants:
+            if name not in trainers:
+                continue
             t = trainers[name]
             tdir = f"/tmp/ab_prof/{name}_{r}"
             import os
@@ -118,13 +107,16 @@ def main():
             jax.profiler.start_trace(tdir)
             np.asarray(t.update_many(var_datas[name], labels))
             jax.profiler.stop_trace()
-            dev_times[name].append(_module_ms(tdir) / scan_len)
+            dev_times[name].append(_trace_device_ms(tdir) / scan_len)
 
-    flops_fwd = conv_flops_per_image(trainers[variants[0][0]].net)
+    assert trainers, "all variants failed to compile"
+    flops_fwd = conv_flops_per_image(next(iter(trainers.values())).net)
     dev = jax.devices()[0].device_kind
     peak = next((v for k, v in PEAK_FLOPS.items() if k in dev), 197e12)
     base_med = base_dev = None
     for name, _ in variants:
+        if name not in trainers:
+            continue
         ts = sorted(times[name])
         med = ts[len(ts) // 2]
         dts = sorted(dev_times[name])

@@ -1,0 +1,65 @@
+"""Scale the transformer LM flagship (VERDICT r3 item 6): d>=1024,
+>=12 layers, s4096, flash attention (+ optional remat); report tok/s and
+model-FLOPs MFU per config.
+
+Usage: python experiments/tf_scale.py [configs...]
+  config := d,nlayer,batch,remat  e.g. 1024,12,8,0
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def run(dim, nlayer, batch, remat, vocab=8192, seq=4096, scan_len=4):
+    from __graft_entry__ import _make_trainer
+    from bench import transformer_flops_per_token, peak_flops
+    from cxxnet_tpu.models import transformer
+    extra = [("dtype", "bfloat16"), ("updater", "adam"),
+             ("eval_train", "0"), ("silent", "1")]
+    if remat:
+        extra.append(("remat", str(remat)))
+    t = _make_trainer(
+        transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nlayer,
+                    nhead=dim // 64),
+        batch, "tpu", extra=extra)
+    kd = jax.random.PRNGKey(0)
+    toks = jax.jit(lambda k: jax.random.randint(
+        k, (scan_len, batch, 1, 1, seq), 0, vocab).astype(jnp.float32))(kd)
+    labels = jax.jit(lambda a: jnp.roll(a, -1, axis=-1).reshape(
+        scan_len, batch, seq))(toks)
+    t.start_round(1)
+    c0 = time.perf_counter()
+    np.asarray(t.update_many(toks, labels))
+    print(f"  compile+warm {time.perf_counter()-c0:.0f}s",
+          file=sys.stderr, flush=True)
+    ms = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        np.asarray(t.update_many(toks, labels))
+        ms.append((time.perf_counter() - t0) / scan_len * 1e3)
+    med = sorted(ms)[len(ms) // 2]
+    tok_s = batch * seq / (med / 1e3)
+    f_tok = transformer_flops_per_token(vocab, seq, dim, nlayer)
+    mfu = 3.0 * f_tok * tok_s / peak_flops(jax.devices()[0].device_kind)
+    print(f"d{dim} L{nlayer} b{batch} remat={remat}: "
+          f"step {med:.1f} ms [{min(ms):.1f}..{max(ms):.1f}]  "
+          f"{tok_s/1e3:.1f}k tok/s  MFU {mfu*100:.1f}% "
+          f"({f_tok/1e6:.0f} MF/tok)", flush=True)
+    del t, toks, labels
+
+
+if __name__ == "__main__":
+    cfgs = sys.argv[1:] or ["1024,12,8,0"]
+    for cfg in cfgs:
+        d, nl, b, rm = (int(v) for v in cfg.split(","))
+        try:
+            run(d, nl, b, rm)
+        except Exception as e:
+            print(f"{cfg}: FAILED {str(e).splitlines()[0][:140]}",
+                  flush=True)

@@ -574,3 +574,39 @@ def test_input_s2d_pre_staged_delivery():
     with pytest.raises(AssertionError, match="padded first conv"):
         padded.update(DataBatch(data=xb2, label=y.reshape(16, 1),
                                 index=np.arange(16, dtype=np.uint32)))
+
+
+def test_relu_pool_reorder_matches():
+    """pool_relu_reorder moves relu after max pooling (they commute);
+    the trajectory must match the unreordered path, since differing
+    argmax ties all receive zero gradient through the relu mask."""
+    from cxxnet_tpu.engine import opts, set_engine_option
+    old = opts.pool_relu_reorder
+    try:
+        set_engine_option("pool_relu_reorder", "0")
+        ref = make_trainer(S2D_CONF)
+        set_engine_option("pool_relu_reorder", "1")
+        ro = make_trainer(S2D_CONF)
+        assert any(getattr(c.layer, "relu_after", False)
+                   for c in ro.net.connections), "reorder did not fire"
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                ro.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
+        rnd = np.random.RandomState(21)
+        for _ in range(4):
+            x = rnd.randn(16, 3, 21, 21).astype(np.float32)
+            y = (rnd.rand(16) * 4).astype(np.float32)
+            b = DataBatch(data=x, label=y.reshape(16, 1),
+                          index=np.arange(16, dtype=np.uint32))
+            ref.update(b)
+            ro.update(b)
+            np.testing.assert_allclose(
+                np.asarray(ro._last_loss), np.asarray(ref._last_loss),
+                rtol=1e-5)
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                np.testing.assert_allclose(
+                    np.asarray(ro.params[pkey][tag]), np.asarray(v),
+                    rtol=1e-4, atol=1e-6, err_msg=f"{pkey}/{tag}")
+    finally:
+        set_engine_option("pool_relu_reorder", old)
