@@ -149,8 +149,13 @@ class ReluMaxPoolingLayer(_PoolingBase):
     type_names = ("relu_max_pooling",)
 
     def forward(self, params, buffers, inputs, ctx):
+        from ..engine import opts
         from .activation import apply_relu
         p = self.param
+        if opts.pool_relu_reorder != "1":
+            x = apply_relu(inputs[0])
+            return [N.max_pool2d(x, p.kernel_height, p.kernel_width,
+                                 p.stride, p.pad_y, p.pad_x)], buffers
         x = N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
                          p.stride, p.pad_y, p.pad_x)
         return [apply_relu(x)], buffers

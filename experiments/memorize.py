@@ -6,7 +6,7 @@ set (loss < 0.5), which becomes the recorded CONVERGENCE.jsonl artifact.
 All data is generated/staged on device once; each dispatch runs k steps.
 
 Usage: python experiments/memorize.py [eta] [steps] [batch] [nsamp] [extra...]
-  extra tokens: clip=<v> noaug (strip dropout) net=googlenet
+  extra tokens: clip=<v> noaug (strip dropout) net=googlenet s2d
 """
 import sys
 import time
@@ -40,6 +40,10 @@ def main():
         net = "\n".join(l for l in net.splitlines()
                         if "dropout" not in l and "threshold" not in l)
     extra = [("dtype", "bfloat16"), ("eval_train", "0"), ("silent", "1")]
+    if "s2d" in opts:
+        # round-4 default bench config: input-boundary space-to-depth
+        # (device-fallback transform path; correctness, not throughput)
+        extra.append(("input_s2d", "1"))
     if clip:
         extra.append(("clip_gradient", clip))
     t = _make_trainer(net, batch, "tpu", extra=extra)

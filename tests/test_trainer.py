@@ -589,6 +589,9 @@ def test_relu_pool_reorder_matches():
         ro = make_trainer(S2D_CONF)
         assert any(getattr(c.layer, "relu_after", False)
                    for c in ro.net.connections), "reorder did not fire"
+        assert not any(getattr(c.layer, "relu_after", False)
+                       for c in ref.net.connections), \
+            "reference trainer must build the unreordered graph"
         for pkey, group in ref.params.items():
             for tag, v in group.items():
                 ro.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
