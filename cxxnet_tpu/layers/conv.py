@@ -38,6 +38,10 @@ class ConvolutionLayer(Layer):
         # pre-transformed to space-to-depth layout (staged once, outside
         # the step), so forward runs the dense stride-1 conv
         self.s2d_input = 0
+        # set by the trainer's relu/bias->pool reorder: the bias add (and
+        # its gradient reduce) moves to the downstream max pool's
+        # stride^2-smaller tensor (max(z + b) == max(z) + b per channel)
+        self.defer_bias = 0
 
     def set_param(self, name: str, val: str) -> None:
         if name == "space_to_depth":
@@ -79,11 +83,12 @@ class ConvolutionLayer(Layer):
         x = inputs[0]
         if self.s2d_input:
             out = N.conv2d_pres2d(x, params["wmat"], stride=p.stride)
-            if "bias" in params:
+            if "bias" in params and not self.defer_bias:
                 out = out + params["bias"].astype(out.dtype).reshape(
                     1, -1, 1, 1)
             return [out], buffers
         if ("bias" in params and not self.space_to_depth
+                and not self.defer_bias
                 and N.use_fast_wgrad(x.shape[1], p.stride, p.num_group)):
             out = N.conv_bias_fast(x, params["wmat"], params["bias"],
                                    p.stride, p.pad_y, p.pad_x)
@@ -94,7 +99,7 @@ class ConvolutionLayer(Layer):
         else:
             out = N.conv2d(x, params["wmat"], stride=p.stride,
                            pad_y=p.pad_y, pad_x=p.pad_x, num_group=p.num_group)
-        if "bias" in params:
+        if "bias" in params and not self.defer_bias:
             out = out + params["bias"].astype(out.dtype).reshape(1, -1, 1, 1)
         return [out], buffers
 
@@ -130,11 +135,18 @@ class MaxPoolingLayer(_PoolingBase):
     # either way), and gradients agree a.e. (argmax ties that differ
     # all receive zero gradient through the relu mask)
     relu_after = False
+    # key of an upstream conv whose bias add was deferred through this
+    # pool (max commutes with a per-channel constant); the executor
+    # injects the bias under "deferred_bias" — see net.conn_params
+    deferred_bias_key = None
 
     def forward(self, params, buffers, inputs, ctx):
         p = self.param
         out = N.max_pool2d(inputs[0], p.kernel_height, p.kernel_width,
                            p.stride, p.pad_y, p.pad_x)
+        if "deferred_bias" in params:
+            out = out + params["deferred_bias"].astype(out.dtype).reshape(
+                1, -1, 1, 1)
         if self.relu_after:
             from .activation import apply_relu
             out = apply_relu(out)
@@ -142,9 +154,10 @@ class MaxPoolingLayer(_PoolingBase):
 
 
 class ReluMaxPoolingLayer(_PoolingBase):
-    """relu fused into max pooling (layer_impl-inl.hpp:55-56).  Computed
-    as relu(pool(x)) — same math (max commutes with relu), but the relu
-    runs on the stride^2-smaller pooled tensor."""
+    """relu fused into max pooling (layer_impl-inl.hpp:55-56).  Under
+    ``pool_relu_reorder = 1`` (default) computed as relu(pool(x)) — same
+    math (max commutes with relu), relu on the stride^2-smaller pooled
+    tensor; ``= 0`` restores the reference pool(relu(x)) order."""
 
     type_names = ("relu_max_pooling",)
 

@@ -144,7 +144,7 @@ class Network:
         new_buffers = dict(buffers)
         for conn in self.connections:
             ins = [nodes[n] for n in conn.nindex_in]
-            p = params.get(conn.param_key, {})
+            p = conn_params(params, conn)
             b = new_buffers.get(conn.param_key, {})
             outs, nb = conn.layer.forward(p, b, ins, ctx)
             # shared connections update the primary's buffer group too: the
@@ -182,3 +182,17 @@ class Network:
             lines.append(f"{i:3d} {conn.layer.type_names[0]:>20s}{share} "
                          f"[{ins} -> {outs}] out={shapes}")
         return "\n".join(lines)
+
+
+def conn_params(params, conn):
+    """Per-connection parameter view.  A max pool carrying a deferred
+    conv bias (the trainer's relu/bias->pool reorder) reads the bias
+    from the conv's group under the key "deferred_bias" — the parameter
+    stays at its original key, so gradients, the updater, sharding, and
+    checkpoints are untouched."""
+    p = params.get(conn.param_key, {})
+    dk = getattr(conn.layer, "deferred_bias_key", None)
+    if dk is not None:
+        p = dict(p)
+        p["deferred_bias"] = params[dk]["bias"]
+    return p

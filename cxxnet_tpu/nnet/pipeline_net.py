@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..layers.base import ForwardContext, LabelInfo
 from ..layers.conv import ConvolutionLayer
 from ..layers.fullc import FullConnectLayer
+from .net import conn_params
 
 
 def _conn_cost(net, ci: int) -> float:
@@ -186,7 +187,7 @@ def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
             for j in range(s0, s1):
                 conn = net.connections[j]
                 ins = [nodes[n] for n in conn.nindex_in]
-                p = params.get(conn.param_key, {})
+                p = conn_params(params, conn)
                 outs, _ = conn.layer.forward(p, {}, ins, ctx)
                 for n, v in zip(conn.nindex_out, outs):
                     nodes[n] = v

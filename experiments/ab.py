@@ -12,6 +12,11 @@ Usage:
   VARIANT := name[:key=val[,key=val...]]
 e.g.
   python experiments/ab.py 1024 6 5 base s2d:input_s2d=1
+
+CAUTION: engine options (pool_bwd, pool_relu_reorder, ...) are process-
+global — a variant that sets one changes the default every LATER variant
+builds with.  Set such options EXPLICITLY on every variant
+(`a:...=0 b:...=1`), never by omission.
 """
 import sys
 import time

@@ -592,6 +592,8 @@ def test_relu_pool_reorder_matches():
         assert not any(getattr(c.layer, "relu_after", False)
                        for c in ref.net.connections), \
             "reference trainer must build the unreordered graph"
+        assert any(getattr(c.layer, "deferred_bias_key", None)
+                   for c in ro.net.connections), "bias deferral did not fire"
         for pkey, group in ref.params.items():
             for tag, v in group.items():
                 ro.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
