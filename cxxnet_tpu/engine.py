@@ -27,7 +27,8 @@ one mid-run does not retrace already-compiled steps.
 | group_conv  | fgc (default), split       | grouped-conv lowering          |
 | conv1_fwd   | conv (default), s2d        | forward lowering for the fast- |
 |             |                            | wgrad conv class               |
-| pallas_lrn  | hwcn (default), 1, 0       | LRN kernel dispatch            |
+| pallas_lrn  | band (default), hwcn, 1, 0 | LRN lowering (band = MXU      |
+|             |                            | banded matmul, round 4)        |
 | relu_vjp    | out (default), xla         | relu backward formulation      |
 | flash_attn  | 1 (default), 0             | Pallas flash attention on TPU  |
 """
@@ -44,7 +45,8 @@ _DEFS = {
                    ("s2d", "hwcn", "pallas", "off")),
     "group_conv": ("CXXNET_GROUP_CONV", "fgc", ("fgc", "split")),
     "conv1_fwd": ("CXXNET_CONV1_FWD", "conv", ("conv", "s2d")),
-    "pallas_lrn": ("CXXNET_PALLAS_LRN", "hwcn", ("hwcn", "1", "0")),
+    "pallas_lrn": ("CXXNET_PALLAS_LRN", "band",
+                   ("band", "hwcn", "1", "0")),
     "relu_vjp": ("CXXNET_RELU_VJP", "out", ("out", "xla")),
     "pool_relu_reorder": ("CXXNET_POOL_RELU_REORDER", "1", ("1", "0")),
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),

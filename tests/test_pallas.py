@@ -329,3 +329,22 @@ def test_conv_wgrad_hwcn_matches_xla(geom):
     np.testing.assert_allclose(np.asarray(db),
                                np.asarray(dy.sum(axis=(0, 2, 3))),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("nsize,beta", [(5, 0.75), (3, 0.5), (4, 0.75)])
+def test_lrn_band_matches_xla(nsize, beta):
+    """Banded-matmul LRN (pallas_lrn = band) == chpool formulation,
+    fwd + grad, including clipped edge windows and the asymmetric
+    even-nsize window (lo != hi)."""
+    x = jnp.asarray(np.random.RandomState(7).randn(3, 96, 5, 5),
+                    jnp.float32)
+    a = N.lrn_band(x, nsize, 0.001, beta, 1.0)
+    b = _xla_lrn(x, nsize, 0.001, beta, 1.0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=1e-6)
+    ga = jax.grad(
+        lambda v: (N.lrn_band(v, nsize, .001, beta, 1.) ** 2).sum())(x)
+    gb = jax.grad(
+        lambda v: (_xla_lrn(v, nsize, .001, beta, 1.) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                               rtol=2e-4, atol=1e-5)
