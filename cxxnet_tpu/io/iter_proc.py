@@ -9,6 +9,7 @@ producer thread mirrors utils/thread_buffer.h with a bounded queue.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import queue
 import sys
@@ -514,3 +515,56 @@ class AttachTxtIterator(IIterator):
             extra.append(feats.reshape(len(feats), 1, 1, -1))
         b.extra_data = extra
         return b
+
+
+def s2d_np(x: np.ndarray, s: int, kh: int, kw: int, oh: int, ow: int,
+           pad_y: int, pad_x: int) -> np.ndarray:
+    """Numpy mirror of ops.nn.s2d_input: (n, c, h, w) -> the input_s2d
+    delivery shape (n, c*s*s, hb, wb), channel order (c, sy, sx).
+    Dtype-preserving (u8 stays u8 — a pure permutation)."""
+    from ..ops.nn import s2d_staged_shape
+    n, c, h, w = x.shape
+    c2, hb, wb = s2d_staged_shape(c, s, kh, kw, oh, ow)
+    xp = np.pad(x, ((0, 0), (0, 0),
+                    (pad_y, max(0, hb * s - h - pad_y)),
+                    (pad_x, max(0, wb * s - w - pad_x))))
+    xp = xp[:, :, :hb * s, :wb * s]
+    xb = xp.reshape(n, c, hb, s, wb, s)
+    return np.ascontiguousarray(
+        xb.transpose(0, 1, 3, 5, 2, 4)).reshape(n, c2, hb, wb)
+
+
+class S2DEmitIterator(IIterator):
+    """Host-side space-to-depth emission (the ``input_s2d`` pipeline
+    contract): transform each batch ON THE HOST so the device staging
+    fallback — a relayout transpose measured 5x off the HBM floor — never
+    runs.  Wraps any assembled-batch iterator; installed by the CLI
+    driver when the trainer reports an s2d geometry (main.py).
+
+    u8 batches through a PADDED first conv are passed through
+    untransformed (u8 cannot encode the normalized zero padding; the
+    trainer's device path normalizes before padding instead)."""
+
+    def __init__(self, base: IIterator, s2d_args):
+        self.base = base
+        (self.s, self.kh, self.kw, self.oh, self.ow,
+         self.pad_y, self.pad_x) = s2d_args
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+
+    def init(self) -> None:
+        self.base.init()
+
+    def before_first(self) -> None:
+        self.base.before_first()
+
+    def next(self):
+        b = self.base.next()
+        if b is None:
+            return None
+        if b.data.dtype == np.uint8 and (self.pad_y or self.pad_x):
+            return b  # device path handles (normalize-then-pad)
+        data = s2d_np(np.asarray(b.data), self.s, self.kh, self.kw,
+                      self.oh, self.ow, self.pad_y, self.pad_x)
+        return dataclasses.replace(b, data=data)

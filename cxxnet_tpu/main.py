@@ -227,9 +227,27 @@ class LearnTask:
                 itcfg = []
                 continue
             (itcfg if flag != 0 else defcfg).append((name, val))
+        # input_s2d: emit space-to-depth batches from the host pipeline
+        # (the device staging transform is a measured-slow fallback);
+        # wrapping happens BEFORE init so a ThreadBufferIterator's
+        # producer thread runs the transform in the prefetch overlap
+        self.itr_train = self._wrap_s2d(self.itr_train)
+        self.itr_evals = [self._wrap_s2d(it) for it in self.itr_evals]
+        self.itr_pred = self._wrap_s2d(self.itr_pred)
         for it in ([self.itr_train] if self.itr_train else []) + \
                 self.itr_evals + ([self.itr_pred] if self.itr_pred else []):
             init_iterator(it, defcfg)
+
+    def _wrap_s2d(self, it):
+        s2d_args = getattr(self.net, "_s2d_args", None) if self.net else None
+        if s2d_args is None or it is None:
+            return it
+        from .io.iter_proc import S2DEmitIterator, ThreadBufferIterator
+        if isinstance(it, ThreadBufferIterator):
+            # transform inside the producer: splice beneath the buffer
+            it.base = S2DEmitIterator(it.base, s2d_args)
+            return it
+        return S2DEmitIterator(it, s2d_args)
 
     # ---------------------------------------------------------------- tasks
     def _save_model(self) -> None:

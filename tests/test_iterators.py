@@ -1,0 +1,55 @@
+"""Iterator-chain unit tests (round 4+): host-side s2d emission.
+Batch-level iterator behaviors live in test_io.py."""
+
+import numpy as np
+
+
+
+def test_s2d_emit_iterator_matches_device_transform():
+    """Host-side s2d emission (the input_s2d pipeline contract) produces
+    exactly the shape/content the device staging transform would, for
+    f32 and u8, with and without conv padding; padded u8 passes through
+    untransformed (the trainer's device path handles it)."""
+    import jax.numpy as jnp
+    from cxxnet_tpu.io.data import DataBatch, IIterator
+    from cxxnet_tpu.io.iter_proc import S2DEmitIterator, s2d_np
+    from cxxnet_tpu.ops import nn as N
+
+    class ListIter(IIterator):
+        def __init__(self, batches):
+            self.batches = batches
+        def before_first(self):
+            self.i = 0
+        def next(self):
+            if self.i >= len(self.batches):
+                return None
+            self.i += 1
+            return self.batches[self.i - 1]
+
+    rnd = np.random.RandomState(3)
+    for dtype, (py, px) in [(np.float32, (0, 0)), (np.float32, (2, 2)),
+                            (np.uint8, (0, 0))]:
+        s, kh, kw = 2, 5, 5
+        h = w = 21
+        oh = N.conv_out_size(h, kh, s, py)
+        ow = N.conv_out_size(w, kw, s, px)
+        x = (rnd.randint(0, 255, (4, 3, h, w)).astype(dtype)
+             if dtype == np.uint8
+             else rnd.randn(4, 3, h, w).astype(dtype))
+        b = DataBatch(data=x, label=np.zeros((4, 1), np.float32),
+                      index=np.arange(4, dtype=np.uint32))
+        it = S2DEmitIterator(ListIter([b]), (s, kh, kw, oh, ow, py, px))
+        it.before_first()
+        out = it.next()
+        want = np.asarray(
+            N.s2d_input(jnp.asarray(x), s, kh, kw, oh, ow, py, px)[0])
+        np.testing.assert_array_equal(out.data, want)
+        assert out.data.dtype == dtype
+        assert it.next() is None
+    # padded u8: passthrough (trainer normalizes before padding on device)
+    x8 = rnd.randint(0, 255, (4, 3, 21, 21)).astype(np.uint8)
+    b8 = DataBatch(data=x8, label=np.zeros((4, 1), np.float32),
+                   index=np.arange(4, dtype=np.uint32))
+    it = S2DEmitIterator(ListIter([b8]), (2, 5, 5, 10, 10, 2, 2))
+    it.before_first()
+    np.testing.assert_array_equal(it.next().data, x8)
