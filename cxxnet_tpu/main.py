@@ -242,10 +242,19 @@ class LearnTask:
         s2d_args = getattr(self.net, "_s2d_args", None) if self.net else None
         if s2d_args is None or it is None:
             return it
-        from .io.iter_proc import S2DEmitIterator, ThreadBufferIterator
-        if isinstance(it, ThreadBufferIterator):
-            # transform inside the producer: splice beneath the buffer
-            it.base = S2DEmitIterator(it.base, s2d_args)
+        from .io.iter_proc import (DenseBufferIterator, S2DEmitIterator,
+                                   ThreadBufferIterator)
+        # splice beneath the DEEPEST buffering stage in the chain so the
+        # transform runs in the prefetch producer thread (threadbuffer)
+        # or once at cache fill (membuffer), not on the consumer path
+        deepest = None
+        cur = it
+        while hasattr(cur, "base") and cur.base is not None:
+            if isinstance(cur, (ThreadBufferIterator, DenseBufferIterator)):
+                deepest = cur
+            cur = cur.base
+        if deepest is not None:
+            deepest.base = S2DEmitIterator(deepest.base, s2d_args)
             return it
         return S2DEmitIterator(it, s2d_args)
 

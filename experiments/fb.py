@@ -16,6 +16,9 @@ import jax.numpy as jnp  # noqa: E402
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
     model = sys.argv[2] if len(sys.argv) > 2 else "alexnet"
+    for a in sys.argv[3:]:
+        assert "=" in a, f"extra args must be key=value, got {a!r}"
+    kvs = [tuple(a.split("=", 1)) for a in sys.argv[3:]]
     scan_len, trials = 10, 2
     from __graft_entry__ import ALEXNET_NET, _make_trainer
     from bench import conv_flops_per_image, PEAK_FLOPS
@@ -27,7 +30,13 @@ def main():
     else:
         conf, shape = ALEXNET_NET, (3, 227, 227)
     t = _make_trainer(conf, batch, "tpu",
-                      extra=[("dtype", "bfloat16"), ("eval_train", "0")])
+                      extra=[("dtype", "bfloat16"),
+                             ("eval_train", "0")] + kvs)
+    if t._s2d_args is not None:
+        # input_s2d: generate data in the pipeline's delivery shape
+        from cxxnet_tpu.ops.nn import s2d_staged_shape
+        s, kh, kw, oh, ow, _, _ = t._s2d_args
+        shape = s2d_staged_shape(shape[0], s, kh, kw, oh, ow)
     # generate on DEVICE: the tunneled host link (and one-core host rand)
     # must not gate a chip-compute measurement
     kd, kl = jax.random.split(jax.random.PRNGKey(0))
