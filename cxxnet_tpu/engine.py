@@ -30,7 +30,18 @@ one mid-run does not retrace already-compiled steps.
 | pallas_lrn  | band (default), hwcn, 1, 0 | LRN lowering (band = MXU      |
 |             |                            | banded matmul, round 4)        |
 | relu_vjp    | out (default), xla         | relu backward formulation      |
+| pool_relu_reorder | 1 (default), 0       | move relu after max pool (and  |
+|             |                            | defer conv bias through it) —  |
+|             |                            | gradient-equivalent a.e.       |
 | flash_attn  | 1 (default), 0             | Pallas flash attention on TPU  |
+
+``opts`` is a PROCESS-GLOBAL singleton: every trainer in the process
+reads it at trace time, so two trainers with different lowering options
+(wrapper API, tests, A/B harnesses) cross-contaminate unless each sets
+every option it cares about before its own first compile — see
+``experiments/ab.py`` for the discipline.  Each trainer snapshots the
+values it read at ``init_model`` into ``trainer.engine_opts_used`` for
+post-hoc auditing.
 """
 
 from __future__ import annotations
@@ -38,7 +49,8 @@ from __future__ import annotations
 import os
 
 _DEFS = {
-    # name: (env var, default, valid values, env value is inverted bool)
+    # name: (env var, default, valid values); flash_attn's env var is an
+    # inverted bool, special-cased in _Options.__init__
     "pool_bwd": ("CXXNET_POOL_BWD", "sas", ("sas", "eq", "gather")),
     "pool_layout": ("CXXNET_POOL_LAYOUT", "nchw", ("nchw", "chwn", "hwcn")),
     "fast_wgrad": ("CXXNET_FAST_WGRAD", "s2d",

@@ -246,6 +246,10 @@ class NetTrainer:
         self._make_shardings()
         self._setup_input_s2d()
         self._reorder_relu_pool()
+        # audit snapshot of the process-global engine options this trainer
+        # compiled against (engine.opts is shared; see engine.py)
+        self.engine_opts_used = {k: getattr(engine.opts, k)
+                                 for k in engine._DEFS}
         self._train_step = self._build_train_step()
         self._multi_step_cache: Dict[int, Any] = {}
         self._eval_step_cache = {}
@@ -325,42 +329,72 @@ class NetTrainer:
         argmax ties all get zero gradient through the relu mask).  The
         relu backward then runs on the stride^2-smaller pooled tensor
         and the pre-relu activation never needs a second full-size HBM
-        pass.  Skipped when the relu's output node has other consumers,
-        is a train-metric eval node, or the relu is a self-loop (its
-        node would then hold the pre-activation)."""
+        pass.  Handles both node forms (``relu`` on a fresh node and the
+        zoo builders' ``layer[+0] = relu`` self-loop — the node then
+        holds the pre-activation between relu and pool, recorded in
+        ``_read_fixups`` for call-time node reads).  Skipped when any
+        later connection other than the pool reads the relu's node, the
+        node is a train-metric eval node, or the layer instance is
+        shared."""
         from ..layers.activation import ReluLayer
-        from ..layers.conv import MaxPoolingLayer
+        from ..layers.conv import ConvolutionLayer, MaxPoolingLayer
+        from ..ops.nn import use_fast_wgrad
+        # node id -> ("relu"|"bias", bias_param_key or None): corrections
+        # extract_feature must apply when reading a node whose stored value
+        # is changed by the reorder (the relu node holds the pre-activation;
+        # a defer_bias conv node holds bias-less output)
+        self._read_fixups: Dict[int, tuple] = {}
         if engine.opts.pool_relu_reorder != "1":
             return
         conns = self.net.connections
-        producer = {}
-        n_consumers: Dict[int, int] = {}
         layer_uses: Dict[int, int] = {}
         for c in conns:
-            for n in c.nindex_out:
-                producer[n] = c
-            for n in c.nindex_in:
-                n_consumers[n] = n_consumers.get(n, 0) + 1
             layer_uses[id(c.layer)] = layer_uses.get(id(c.layer), 0) + 1
-        for c in conns:
-            if not (type(c.layer) is MaxPoolingLayer):
+
+        def last_writer(node, before):
+            for j in range(before - 1, -1, -1):
+                if node in conns[j].nindex_out:
+                    return j
+            return None
+
+        def readers_after(node, start):
+            """Connection indices reading ``node`` after position ``start``
+            (execution order matters: self-loop relus overwrite their node,
+            so earlier readers see a different value and don't count)."""
+            return [j for j in range(start + 1, len(conns))
+                    if node in conns[j].nindex_in]
+
+        for i, c in enumerate(conns):
+            if type(c.layer) is not MaxPoolingLayer:
                 continue
             if layer_uses[id(c.layer)] > 1:
                 # shared layer instance (share[tag] / siamese towers):
                 # flag mutation would leak past this connection's guards
                 continue
-            node = c.nindex_in[0]
-            prod = producer.get(node)
-            if prod is None or type(prod.layer) is not ReluLayer:
+            v = c.nindex_in[0]
+            j = last_writer(v, i)
+            if j is None or type(conns[j].layer) is not ReluLayer:
                 continue
-            if prod.nindex_in == prod.nindex_out:  # self-loop relu
+            relu = conns[j]
+            if layer_uses[id(relu.layer)] > 1:
                 continue
-            if n_consumers.get(node, 0) != 1 or node in self.eval_node_ids:
+            if v in self.eval_node_ids:
                 continue
-            if layer_uses[id(prod.layer)] > 1:
+            # the relu's (post-activation) value may feed nothing but this
+            # pool — after deferral the node holds the pre-activation
+            if readers_after(v, j) != [i]:
                 continue
-            prod.layer.defer_to_pool = True
+            self_loop = relu.nindex_in == relu.nindex_out
+            if self_loop:
+                # zoo-style ``layer[+0] = relu``: node v holds the
+                # pre-activation between the relu and the pool; the conv
+                # beneath is v's previous writer
+                k = last_writer(v, j)
+            else:
+                k = last_writer(relu.nindex_in[0], j)
+            relu.layer.defer_to_pool = True
             c.layer.relu_after = True
+            self._read_fixups[v] = ("relu", None)
             # the conv bias also commutes with max (per-channel constant:
             # max(z + b) == max(z) + b), so when the relu's producer is a
             # biased conv whose output feeds only the (deferred) relu,
@@ -368,15 +402,16 @@ class NetTrainer:
             # tensor too — on AlexNet b1024 the conv1/conv2 bias-grad
             # reduces read 634/572 MB SAS outputs (0.79 + 0.51 ms) that
             # shrink by stride^2
-            from ..layers.conv import ConvolutionLayer
-            from ..ops.nn import use_fast_wgrad
-            cnode = prod.nindex_in[0]
-            cprod = producer.get(cnode)
-            if (cprod is not None
-                    and type(cprod.layer) is ConvolutionLayer
+            if k is None:
+                continue
+            cprod = conns[k]
+            cnode = cprod.nindex_out[0]
+            conv_readers = readers_after(cnode, k)
+            want = [j, i] if self_loop else [j]
+            if (type(cprod.layer) is ConvolutionLayer
                     and not cprod.layer.param.no_bias
                     and layer_uses[id(cprod.layer)] == 1
-                    and n_consumers.get(cnode, 0) == 1
+                    and conv_readers == want
                     and cnode not in self.eval_node_ids
                     and cprod.nindex_in != cprod.nindex_out
                     and (cprod.layer.s2d_input
@@ -386,6 +421,8 @@ class NetTrainer:
                              cprod.layer.param.num_group))):
                 cprod.layer.defer_bias = 1
                 c.layer.deferred_bias_key = cprod.param_key
+                self._read_fixups[cnode] = ("bias", cprod.param_key)
+                self._read_fixups[v] = ("relu", cprod.param_key)
 
     def _setup_input_s2d(self):
         """Wire ``input_s2d = 1``: flag the first conv to consume
@@ -1066,7 +1103,28 @@ class NetTrainer:
                      self._s2d_transform(self._device_batch(batch.data)),
                      tuple(self._device_batch(e) for e in batch.extra_data))
         n_valid = batch.batch_size - batch.num_batch_padd
-        return np.asarray(outs[nid])[:n_valid]
+        return self._apply_read_fixup(nid, np.asarray(outs[nid])[:n_valid])
+
+    def _apply_read_fixup(self, nid: int, out: np.ndarray) -> np.ndarray:
+        """Undo the relu->pool reorder / bias deferral for a node read at
+        call time (extract_feature): the relu node stores the
+        pre-activation and a defer_bias conv node stores bias-less
+        output.  eval_node_ids are excluded from deferral at build time;
+        nodes chosen later get the correction applied here instead."""
+        fix = getattr(self, "_read_fixups", {}).get(nid)
+        if fix is None:
+            return out
+        kind, bias_key = fix
+        flat_shape = out.shape
+        # eval steps return as_mat-flattened (batch, C*H*W); restore the
+        # node's natural shape so the per-channel bias broadcasts
+        out = out.reshape((out.shape[0],) + tuple(self.net.node_shapes[nid][1:]))
+        if bias_key is not None:
+            bias = np.asarray(self.params[bias_key]["bias"]).astype(out.dtype)
+            out = out + bias.reshape((-1,) + (1,) * (out.ndim - 2))
+        if kind == "relu":
+            out = np.maximum(out, out.dtype.type(0))
+        return out.reshape(flat_shape)
 
     # ----------------------------------------------------------- weights IO
     def _resolve_param_key(self, layer_name: str) -> str:

@@ -31,6 +31,9 @@ import jax.numpy as jnp  # noqa: E402
 
 def main():
     args = [a for a in sys.argv[1:]]
+    model = "alexnet"
+    if args and args[0].startswith("model="):
+        model = args.pop(0).split("=", 1)[1]
     nums = []
     while args and args[0].replace(".", "").isdigit():
         nums.append(int(args[0]))
@@ -49,16 +52,27 @@ def main():
     from bench import (conv_flops_per_image, PEAK_FLOPS,
                        _trace_device_ms)
 
+    if model == "alexnet":
+        net_conf, shape = ALEXNET_NET, (3, 227, 227)
+    else:
+        from cxxnet_tpu.models import zoo
+        net_conf = getattr(zoo, model)() + \
+            "metric = error\neta = 0.01\nmomentum = 0.9\nsilent = 1\n"
+        shape_line = [ln for ln in net_conf.splitlines()
+                      if ln.strip().startswith("input_shape")][0]
+        shape = tuple(int(x) for x in
+                      shape_line.split("=", 1)[1].strip().split(","))
+
     kd, kl = jax.random.split(jax.random.PRNGKey(0))
     datas = jax.jit(lambda k: jax.random.uniform(
-        k, (scan_len, batch, 3, 227, 227), jnp.float32
+        k, (scan_len, batch, *shape), jnp.float32
     ).astype(jnp.bfloat16))(kd)
     labels = jax.jit(lambda k: jax.random.randint(
         k, (scan_len, batch, 1), 0, 1000).astype(jnp.float32))(kl)
 
     trainers, var_datas = {}, {}
     for name, extra in variants:
-        t = _make_trainer(ALEXNET_NET, batch, "tpu",
+        t = _make_trainer(net_conf, batch, "tpu",
                           extra=[("dtype", "bfloat16"),
                                  ("eval_train", "0")] + list(extra))
         t.start_round(1)

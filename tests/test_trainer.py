@@ -615,3 +615,106 @@ def test_relu_pool_reorder_matches():
                     rtol=1e-4, atol=1e-6, err_msg=f"{pkey}/{tag}")
     finally:
         set_engine_option("pool_relu_reorder", old)
+
+
+SELF_LOOP_CONF = """
+netconfig=start
+layer[0->1] = conv:c1
+  kernel_size = 5
+  stride = 2
+  nchannel = 8
+  init_sigma = 0.1
+layer[1->1] = relu
+layer[1->2] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[2->3] = flatten
+layer[3->4] = fullc:f1
+  nhidden = 4
+  init_sigma = 0.1
+layer[4->4] = softmax
+netconfig=end
+input_shape = 3,21,21
+batch_size = 16
+dev = cpu
+eta = 0.1
+momentum = 0.9
+metric = error
+silent = 1
+"""
+
+
+def test_relu_pool_reorder_self_loop_matches():
+    """The zoo builders emit ``layer[+0] = relu`` self-loops; the reorder
+    must fire there too (the node holds the pre-activation between relu
+    and pool) and the trajectory must match the unreordered path."""
+    from cxxnet_tpu.engine import opts, set_engine_option
+    old = opts.pool_relu_reorder
+    try:
+        set_engine_option("pool_relu_reorder", "0")
+        ref = make_trainer(SELF_LOOP_CONF)
+        set_engine_option("pool_relu_reorder", "1")
+        ro = make_trainer(SELF_LOOP_CONF)
+        assert any(getattr(c.layer, "relu_after", False)
+                   for c in ro.net.connections), \
+            "reorder did not fire on the self-loop relu"
+        assert any(getattr(c.layer, "deferred_bias_key", None)
+                   for c in ro.net.connections), "bias deferral did not fire"
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                ro.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
+        rnd = np.random.RandomState(77)
+        for _ in range(4):
+            x = rnd.randn(16, 3, 21, 21).astype(np.float32)
+            y = (rnd.rand(16) * 4).astype(np.float32)
+            b = DataBatch(data=x, label=y.reshape(16, 1),
+                          index=np.arange(16, dtype=np.uint32))
+            ref.update(b)
+            ro.update(b)
+            np.testing.assert_allclose(
+                np.asarray(ro._last_loss), np.asarray(ref._last_loss),
+                rtol=1e-5)
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                np.testing.assert_allclose(
+                    np.asarray(ro.params[pkey][tag]), np.asarray(v),
+                    rtol=1e-4, atol=1e-6, err_msg=f"{pkey}/{tag}")
+        # extract on the self-loop node returns the post-relu value
+        x = rnd.randn(16, 3, 21, 21).astype(np.float32)
+        b = DataBatch(data=x, label=np.zeros((16, 1), np.float32),
+                      index=np.arange(16, dtype=np.uint32))
+        np.testing.assert_allclose(
+            ro.extract_feature(b, "1"), ref.extract_feature(b, "1"),
+            rtol=1e-5, atol=1e-6)
+    finally:
+        set_engine_option("pool_relu_reorder", old)
+
+
+def test_extract_feature_on_deferred_nodes():
+    """extract_feature on nodes inside a deferred conv->relu->pool block
+    must return the undeferred values: the relu node physically holds the
+    pre-activation and the defer_bias conv node holds bias-less output,
+    so the trainer re-applies relu/bias on read (_apply_read_fixup)."""
+    from cxxnet_tpu.engine import opts, set_engine_option
+    old = opts.pool_relu_reorder
+    try:
+        set_engine_option("pool_relu_reorder", "0")
+        ref = make_trainer(S2D_CONF)
+        set_engine_option("pool_relu_reorder", "1")
+        ro = make_trainer(S2D_CONF)
+        assert ro._read_fixups, "deferral fired but no read fixups recorded"
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                ro.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
+        rnd = np.random.RandomState(33)
+        x = rnd.randn(16, 3, 21, 21).astype(np.float32)
+        b = DataBatch(data=x, label=np.zeros((16, 1), np.float32),
+                      index=np.arange(16, dtype=np.uint32))
+        for node in ("1", "2", "3"):  # conv out, relu out, pool out
+            got = ro.extract_feature(b, node)
+            want = ref.extract_feature(b, node)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"node {node}")
+        assert ref.extract_feature(b, "2").min() >= 0.0
+    finally:
+        set_engine_option("pool_relu_reorder", old)
