@@ -67,6 +67,9 @@ class NetTrainer:
         # pipeline parallelism (mesh = pipe:K): microbatches per step;
         # 0 = auto (2 * pipe size, the usual bubble/efficiency trade)
         self.pipe_microbatch = 0
+        # gpipe (fill-drain, grads by autodiff) or 1f1b (interleaved
+        # schedule, activation footprint flat in microbatch count)
+        self.pipe_schedule = "gpipe"
         self._pipe_partition = None
         # u8 input path: normalization constants applied ON DEVICE when a
         # batch arrives as uint8 (4x less host work + 2-4x less transfer;
@@ -126,6 +129,10 @@ class NetTrainer:
             self.fullc_gather = int(val)
         elif name == "pipe_microbatch":
             self.pipe_microbatch = int(val)
+        elif name == "pipe_schedule":
+            assert val in ("gpipe", "1f1b"), \
+                f"pipe_schedule = {val}: expected gpipe or 1f1b"
+            self.pipe_schedule = val
         elif name == "remat":
             self.remat = int(val)
         elif name == "scale":
@@ -247,9 +254,12 @@ class NetTrainer:
         self._setup_input_s2d()
         self._reorder_relu_pool()
         # audit snapshot of the process-global engine options this trainer
-        # compiled against (engine.opts is shared; see engine.py)
-        self.engine_opts_used = {k: getattr(engine.opts, k)
-                                 for k in engine._DEFS}
+        # compiles against (engine.opts is shared; see engine.py) — taken
+        # at FIRST TRACE, not here: jit traces lazily, so options changed
+        # between init_model and the first step would make an init-time
+        # snapshot misreport exactly the cross-trainer contamination it
+        # exists to catch
+        self.engine_opts_used = None
         self._train_step = self._build_train_step()
         self._multi_step_cache: Dict[int, Any] = {}
         self._eval_step_cache = {}
@@ -580,6 +590,82 @@ class NetTrainer:
                                    rng, epoch, mask, train=train,
                                    body_loss=aux_losses.sum())
 
+    def _pipeline_1f1b_loss_and_grads(self, params, buffers, data,
+                                      label_vec, epoch, rng, eval_ids,
+                                      mask):
+        """``pipe_schedule = 1f1b``: loss AND gradients come out of the
+        interleaved schedule directly — ``jax.grad`` of the GPipe forward
+        stores residuals for every tick, while 1F1B bounds live
+        activations at ``2S-1`` microbatches regardless of microbatch
+        count (see :func:`parallel.pipeline.pipeline_1f1b_hetero`)."""
+        from ..parallel.pipeline import pipeline_1f1b_hetero
+        from . import pipeline_net
+        from .net import conn_params
+        stages, body_end = self._pipe_setup()
+        n_stage = self.mesh.shape["pipe"]
+        stage_fns = pipeline_net.make_stage_fns(
+            self.net, stages, body_end, train=True, epoch=epoch,
+            loss_scale=self.loss_scale, rng=rng)
+        data = self._normalize_input(data)
+        b = data.shape[0]
+        n_micro = self.pipe_microbatch or 2 * n_stage
+        assert b % n_micro == 0, (
+            f"pipeline: batch {b} not divisible by pipe_microbatch "
+            f"{n_micro}")
+        x = data.astype(self.dtype).reshape(n_micro, b // n_micro,
+                                            *data.shape[1:])
+        mb = b // n_micro
+        extra = {
+            "fields": {name: label_vec[:, a:b_].reshape(n_micro, mb, -1)
+                       for name, a, b_ in self._label_fields}
+            if label_vec is not None else {},
+            "mask": None if mask is None else mask.reshape(n_micro, mb),
+        }
+        frontier = pipeline_net.frontier_nodes(self.net, body_end)
+
+        def tail_loss(p, boundary, extra_m, m):
+            """Per-microbatch training loss: trailing loss connections on
+            the last boundary + the aux terms threaded through the body
+            (additive, so their cotangent seeds at 1 automatically)."""
+            acts, aux = boundary
+            nodes = dict(zip(frontier, acts))
+            fields, mb_mask = extra_m["fields"], extra_m["mask"]
+            ctx = ForwardContext(
+                train=True, rng=rng,
+                labels=LabelInfo(fields=fields, mask=mb_mask)
+                if fields or mb_mask is not None else None,
+                epoch=epoch, loss_scale=self.loss_scale, mesh=None)
+            for conn in self.net.connections[body_end:]:
+                ins = [nodes[n] for n in conn.nindex_in]
+                pp = conn_params(p, conn)
+                outs_, _ = conn.layer.forward(pp, {}, ins, ctx)
+                for n, v in zip(conn.nindex_out, outs_):
+                    nodes[n] = v
+            total = aux
+            for l in ctx.losses:
+                total = total + l
+            return total
+
+        loss, grads, outs = pipeline_1f1b_hetero(
+            stage_fns, tail_loss, params, x, mesh=self.mesh,
+            data_spec=self.batch_shard.spec, extra=extra)
+        # train-metric eval nodes: forward the loss tail once on the
+        # collected last-boundary activations (no grad — the 1F1B scan
+        # already produced the gradients)
+        nodes = {n: o.reshape(b, *o.shape[2:])
+                 for n, o in zip(frontier, outs)}
+        nodes, ctx = self._run_loss_tail(params, nodes, body_end,
+                                         label_vec, rng, epoch, mask,
+                                         train=True)
+        for nid in eval_ids:
+            assert nid in nodes, (
+                "pipeline: train-metric eval nodes must sit at or "
+                "after the last stage boundary")
+        outs_eval = {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                     for nid in eval_ids}
+        grads = jax.tree.map(lambda p, g: g.astype(p.dtype), params, grads)
+        return (loss, (buffers, outs_eval, ctx.diagnostics)), grads
+
     def _run_loss_tail(self, params, nodes, body_end, label_vec, rng,
                        epoch, mask, *, train, body_loss=None):
         """Run the trailing loss connections on the body-boundary node
@@ -671,6 +757,11 @@ class NetTrainer:
 
             assert any(c.layer.is_loss for c in self.net.connections), \
                 "network has no loss layer; cannot train"
+
+            if self.pipe_schedule == "1f1b":
+                return self._pipeline_1f1b_loss_and_grads(
+                    params, buffers, data, label_vec, epoch, rng, eval_ids,
+                    mask)
 
             def loss_fn(p):
                 nodes, ctx = self._pipeline_forward(
@@ -852,6 +943,7 @@ class NetTrainer:
             lambda a: (a.shape[0], self.batch_size) + a.shape[2:])
 
     def update_many(self, datas, labels, with_outs: bool = False):
+        self._note_engine_opts()
         """Run ``k`` sequential training steps in one device dispatch.
 
         ``datas``: (k, batch, c, h, w); ``labels``: (k, batch, label_width).
@@ -878,6 +970,7 @@ class NetTrainer:
         return losses
 
     def _build_eval_many(self, k: int, node_ids: Tuple[int, ...]):
+        self._note_engine_opts()
         """One jitted ``lax.scan`` over ``k`` eval batches: one dispatch +
         one D2H per group instead of per batch (VERDICT r3 weak 7 — on a
         tunneled link the per-batch sync made Evaluate disproportionately
@@ -904,6 +997,7 @@ class NetTrainer:
         return fn
 
     def _get_eval_step(self, node_ids: Tuple[int, ...]):
+        self._note_engine_opts()
         if node_ids in self._eval_step_cache:
             return self._eval_step_cache[node_ids]
 
@@ -951,7 +1045,13 @@ class NetTrainer:
     def _grad_acc_init(self):
         return jax.tree.map(jnp.zeros_like, self.params)
 
+    def _note_engine_opts(self) -> None:
+        if getattr(self, "engine_opts_used", None) is None:
+            self.engine_opts_used = {k: getattr(engine.opts, k)
+                                     for k in engine._DEFS}
+
     def update(self, batch: DataBatch) -> None:
+        self._note_engine_opts()
         self.sample_counter += 1
         do_update = (self.sample_counter % self.update_period == 0)
         epoch = self.epoch_counter

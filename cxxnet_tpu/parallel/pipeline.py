@@ -309,6 +309,179 @@ def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x, labels, *,
         check_rep=False)(stacked_params, x, labels)
 
 
+def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
+                         axis: str = "pipe", data_spec: P = P(),
+                         extra=None):
+    """1F1B schedule over *heterogeneous* stages — the netconfig-integrated
+    counterpart of :func:`pipeline_1f1b` (``pipe_schedule = 1f1b``).
+
+    ``stage_fns`` are :func:`cxxnet_tpu.nnet.pipeline_net.make_stage_fns`
+    callables (boundary value = ``(acts tuple, aux-loss scalar, extra)``);
+    ``tail_loss_fn(params, (acts, aux), extra_m, m)`` maps the LAST stage's
+    output boundary for one microbatch to the scalar training loss
+    (trailing loss connections + the threaded aux terms).  ``x`` is
+    ``(n_micro, mb, ...)`` microbatches; ``extra`` the per-microbatch
+    label-fields/mask pytree.  Returns ``(loss, grads, outs)``: summed
+    per-microbatch loss, parameter gradients (f32, summed over pipe +
+    data axes, replicated), and the stacked last-boundary activations
+    (``(n_micro, mb, ...)`` per frontier node) for train-metric eval.
+
+    Schedule identical to :func:`pipeline_1f1b` (stage ``s`` forwards
+    microbatch ``t - s`` and backwards ``t - (2S - 2 - s)`` at tick
+    ``t``); because boundary shapes differ per stage, the rotating
+    buffers and saved-input rings are K-tuples (one slot per boundary,
+    every device carries all K — the uniform-SPMD-program requirement),
+    so the activation footprint is ``(2S - 1) * sum_s |boundary_s|``,
+    flat in ``n_micro`` where GPipe-by-autodiff stores all ``n_micro``
+    tick residuals.  Per-stage forward recompute inside ``jax.vjp`` is
+    the standard 1F1B trade; randomness keys match the forward half
+    (``fold_in(rng, m * S + s)`` in make_stage_fns), so dropout masks
+    agree between the two passes.
+    """
+    n_stage = mesh.shape[axis]
+    n_micro = x.shape[0]
+    ticks = n_micro + 2 * n_stage - 2
+    ring = 2 * n_stage - 1
+    fwd_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    bwd_perm = [(i, (i - 1) % n_stage) for i in range(n_stage)]
+    data_axes = [a for d in data_spec if d is not None
+                 for a in (d if isinstance(d, tuple) else (d,))]
+
+    def spmd(params, xs, *erest):
+        idx = lax.axis_index(axis)
+
+        def extra_at(m):
+            return jax.tree.map(lambda a: a[m], erest[0]) if erest \
+                else {"fields": {}, "mask": None}
+
+        def run_fwd(s, p, acts, aux, m):
+            y = stage_fns[s](p, (acts, aux, extra_at(m)), m)
+            return y[0], y[1]
+
+        # boundary shapes via the shape-only chain (no compute)
+        bshapes = []
+        cur = jax.eval_shape(lambda: ((xs[0],), jnp.float32(0.0)))
+        in_shapes = []
+        for s in range(n_stage):
+            in_shapes.append(cur)
+            cur = jax.eval_shape(
+                lambda p, v, s=s: run_fwd(s, p, v[0], v[1], 0), params, cur)
+            bshapes.append(cur)
+
+        def zeros_of(tree):
+            return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), tree)
+
+        def tick(carry, t):
+            fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
+
+            def mk_branch(s):
+                def br(carry):
+                    fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
+                    # ------------------------------------ forward half
+                    mf = t - s
+                    f_on = (mf >= 0) & (mf < n_micro)
+                    mf_c = jnp.clip(mf, 0, n_micro - 1)
+                    inp = ((xs[mf_c],), jnp.float32(0.0)) if s == 0 \
+                        else fwd_bufs[s - 1]
+                    slot = jnp.where(f_on, mf_c % ring, ring)
+                    rings = tuple(
+                        jax.tree.map(
+                            lambda buf, v: lax.dynamic_update_slice_in_dim(
+                                buf, v[None], slot, axis=0), rings[j], inp)
+                        if j == s else rings[j] for j in range(n_stage))
+                    y = run_fwd(s, params, inp[0], inp[1], mf_c)
+                    fwd_bufs = tuple(y if j == s else fwd_bufs[j]
+                                     for j in range(n_stage))
+                    # ----------------------------------- backward half
+                    mb = t - (2 * n_stage - 2 - s)
+                    b_on = (mb >= 0) & (mb < n_micro)
+                    mb_c = jnp.clip(mb, 0, n_micro - 1)
+                    saved = jax.tree.map(
+                        lambda buf: lax.dynamic_index_in_dim(
+                            buf, mb_c % ring, axis=0, keepdims=False),
+                        rings[s])
+                    if s == n_stage - 1:
+                        # fwd and bwd of a microbatch share the tick on
+                        # the last stage: seed the cotangent chain from
+                        # the loss directly (value_and_grad through the
+                        # stage + loss tail in one go)
+                        def with_tail(p, acts, aux):
+                            ya, yl = run_fwd(s, p, acts, aux, mb_c)
+                            return tail_loss_fn(
+                                p, (ya, yl), extra_at(mb_c),
+                                mb_c).astype(jnp.float32)
+                        loss_m, (dp, da, dl) = jax.value_and_grad(
+                            with_tail, argnums=(0, 1, 2))(
+                                params, saved[0], saved[1])
+                    else:
+                        _, vjp = jax.vjp(
+                            lambda p, acts, aux: run_fwd(
+                                s, p, acts, aux, mb_c),
+                            params, saved[0], saved[1])
+                        dp, da, dl = vjp(ct_bufs[s])
+                        loss_m = jnp.float32(0.0)
+                    # where-mask, not multiply: bubble ticks run the vjp
+                    # on zero/garbage activations and 0 * NaN would
+                    # poison the accumulator permanently
+                    grad_acc = jax.tree.map(
+                        lambda a, d: jnp.where(b_on, a + d.astype(a.dtype),
+                                               a),
+                        grad_acc, dp)
+                    loss_acc = loss_acc + jnp.where(b_on, loss_m, 0.0)
+                    if s >= 1:
+                        ct_bufs = tuple((da, dl) if j == s - 1 else ct_bufs[j]
+                                        for j in range(n_stage))
+                    return fwd_bufs, ct_bufs, rings, grad_acc, loss_acc
+                return br
+
+            carry = lax.switch(idx, [mk_branch(s) for s in range(n_stage)],
+                               carry)
+            fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
+            y_last = fwd_bufs[n_stage - 1][0]
+            fwd_bufs = tuple(
+                jax.tree.map(lambda a: lax.ppermute(a, axis, fwd_perm), b)
+                for b in fwd_bufs)
+            ct_bufs = tuple(
+                jax.tree.map(lambda a: lax.ppermute(a, axis, bwd_perm), b)
+                for b in ct_bufs)
+            return (fwd_bufs, ct_bufs, rings, grad_acc, loss_acc), y_last
+
+        init = (tuple(zeros_of(b) for b in bshapes),
+                tuple(zeros_of(b) for b in bshapes),
+                tuple(jax.tree.map(
+                    lambda a: jnp.zeros((ring + 1,) + a.shape, a.dtype),
+                    in_shapes[s]) for s in range(n_stage)),
+                jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params),
+                jnp.float32(0.0))
+        carry, ys = lax.scan(tick, init, jnp.arange(ticks))
+        _, _, _, grad_acc, loss_acc = carry
+        # microbatch m leaves the last stage at tick m + S - 1
+        out_last = jax.tree.map(
+            lambda a: a[n_stage - 1:n_stage - 1 + n_micro], ys)
+        valid = idx == n_stage - 1
+        out_last = jax.tree.map(
+            lambda a: a * valid.astype(a.dtype), out_last)
+        outs = lax.psum(out_last, axis)
+        loss = lax.psum(loss_acc, axis)
+        grads = lax.psum(grad_acc, (axis, *data_axes))
+        if data_axes:
+            loss = lax.psum(loss, tuple(data_axes))
+        return loss, grads, outs
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    xspec = P(None, *data_spec)
+    operands, in_specs = (params, x), (pspec, xspec)
+    if extra is not None:
+        operands += (extra,)
+        in_specs += (P(None, *list(data_spec)[:1]),)
+    gspec = jax.tree.map(lambda _: P(), params)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=in_specs, out_specs=(P(), gspec, xspec),
+        check_rep=False)(*operands)
+
+
 def pipeline_train_step(stage_fn, loss_fn, stacked_params, x, labels, *,
                         mesh, axis="pipe", lr=0.1):
     """One jitted pipelined SGD step: forward pipeline, loss on the last
