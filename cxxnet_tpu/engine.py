@@ -33,6 +33,14 @@ one mid-run does not retrace already-compiled steps.
 | pool_relu_reorder | 1 (default), 0       | move relu after max pool (and  |
 |             |                            | defer conv bias through it) —  |
 |             |                            | gradient-equivalent a.e.       |
+| conv_sibling_fuse | 0 (default), 1       | run same-input same-geometry   |
+|             |                            | convs (inception 1x1 reduces)  |
+|             |                            | as one fused conv + slices     |
+| concat_virtual | 0 (default), 1          | ch_concat stays a virtual      |
+|             |                            | segment tuple; convs consume   |
+|             |                            | it as K-sliced sums, pools map |
+|             |                            | per segment (layers/base.py    |
+|             |                            | ChSegs)                        |
 | flash_attn  | 1 (default), 0             | Pallas flash attention on TPU  |
 
 ``opts`` is a PROCESS-GLOBAL singleton: every trainer in the process
@@ -40,8 +48,10 @@ reads it at trace time, so two trainers with different lowering options
 (wrapper API, tests, A/B harnesses) cross-contaminate unless each sets
 every option it cares about before its own first compile — see
 ``experiments/ab.py`` for the discipline.  Each trainer snapshots the
-values it read at ``init_model`` into ``trainer.engine_opts_used`` for
-post-hoc auditing.
+values it read at FIRST TRACE (its first update/eval call — jit traces
+lazily, so an init-time snapshot could misreport) into
+``trainer.engine_opts_used`` for post-hoc auditing; before the first
+trace the attribute is ``None``.
 """
 
 from __future__ import annotations
@@ -58,9 +68,11 @@ _DEFS = {
     "group_conv": ("CXXNET_GROUP_CONV", "fgc", ("fgc", "split")),
     "conv1_fwd": ("CXXNET_CONV1_FWD", "conv", ("conv", "s2d")),
     "pallas_lrn": ("CXXNET_PALLAS_LRN", "band",
-                   ("band", "hwcn", "1", "0")),
+                   ("band", "bandconv", "hwcn", "1", "0")),
     "relu_vjp": ("CXXNET_RELU_VJP", "out", ("out", "xla")),
     "pool_relu_reorder": ("CXXNET_POOL_RELU_REORDER", "1", ("1", "0")),
+    "conv_sibling_fuse": ("CXXNET_CONV_SIBLING_FUSE", "0", ("1", "0")),
+    "concat_virtual": ("CXXNET_CONCAT_VIRTUAL", "0", ("1", "0")),
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),
 }
 

@@ -42,7 +42,39 @@ def mat_shape(s: Shape4) -> Tuple[int, int]:
     return (s[0], s[1] * s[2] * s[3])
 
 
+class ChSegs:
+    """Virtual channel concat (``concat_virtual = 1``): the value of a
+    ``ch_concat`` node held as its branch segments instead of one
+    materialized buffer.  Channelwise consumers (split, pools) operate
+    per segment; a conv consumes it as a sum of K-sliced convs — so
+    inception concats stop costing a full HBM copy forward and a
+    slice-split backward.  Any unaware consumer materializes lazily
+    (``materialize()``, cached).  Python-level only: never crosses a jit
+    boundary; autodiff sees the underlying ops."""
+
+    __slots__ = ("segs", "_mat")
+
+    def __init__(self, segs):
+        self.segs = list(segs)
+        self._mat = None
+
+    @property
+    def shape(self):
+        n, _, h, w = self.segs[0].shape
+        return (n, sum(s.shape[1] for s in self.segs), h, w)
+
+    def materialize(self):
+        if self._mat is None:
+            self._mat = jnp.concatenate(self.segs, axis=1)
+        return self._mat
+
+
+def materialize(x):
+    return x.materialize() if isinstance(x, ChSegs) else x
+
+
 def as_mat(x: jnp.ndarray) -> jnp.ndarray:
+    x = materialize(x)
     return x.reshape(x.shape[0], -1)
 
 

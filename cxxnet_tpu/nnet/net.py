@@ -138,12 +138,24 @@ class Network:
         Returns (node value list indexed by node id, updated buffers).
         Node values are SSA: self-loop layers rebind their node's entry.
         """
+        from .. import engine
+        from ..layers.base import materialize
         nodes: List[Optional[jnp.ndarray]] = [None] * self.cfg.num_nodes
         for nid, v in inputs.items():
             nodes[nid] = v.astype(self.dtype) if v.dtype != self.dtype else v
         new_buffers = dict(buffers)
-        for conn in self.connections:
-            ins = [nodes[n] for n in conn.nindex_in]
+        fuse = getattr(self, "fuse_groups", None)
+        fuse_skip = getattr(self, "fuse_skip", frozenset())
+        virtual = engine.opts.concat_virtual == "1"
+        for i, conn in enumerate(self.connections):
+            if i in fuse_skip:
+                continue
+            if fuse and i in fuse:
+                self._forward_fused(fuse[i], params, nodes)
+                continue
+            if virtual and self._virtual_forward(conn, params, nodes):
+                continue
+            ins = [materialize(nodes[n]) for n in conn.nindex_in]
             p = conn_params(params, conn)
             b = new_buffers.get(conn.param_key, {})
             outs, nb = conn.layer.forward(p, b, ins, ctx)
@@ -154,6 +166,91 @@ class Network:
             for n, v in zip(conn.nindex_out, outs):
                 nodes[n] = v
         return nodes, new_buffers
+
+    def _virtual_forward(self, conn, params, nodes) -> bool:
+        """``concat_virtual = 1``: execute ``conn`` on virtual channel
+        segments where the layer is segment-aware; return False to fall
+        back to the materializing path.  ch_concat PRODUCES a ChSegs;
+        split replicates it; channelwise pools map over segments (concat
+        commutes with them); a conv consumes it as a sum of K-sliced
+        convs (conv(concat(x_i), W) == sum_i conv(x_i, W[:, K_i])) — the
+        inception module chain then never materializes its concats."""
+        from ..layers.base import ChSegs
+        from ..layers.conv import (AvgPoolingLayer, ConvolutionLayer,
+                                   MaxPoolingLayer, SumPoolingLayer)
+        from ..layers.shape_ops import ChConcatLayer, SplitLayer
+        from ..ops import nn as N
+        l = conn.layer
+        if type(l) is ChConcatLayer and len(conn.nindex_out) == 1:
+            segs = []
+            for n in conn.nindex_in:
+                v = nodes[n]
+                segs.extend(v.segs if isinstance(v, ChSegs) else [v])
+            nodes[conn.nindex_out[0]] = ChSegs(segs)
+            return True
+        if len(conn.nindex_in) != 1 or len(conn.nindex_out) == 0:
+            return False
+        v = nodes[conn.nindex_in[0]]
+        if not isinstance(v, ChSegs):
+            return False
+        if type(l) is SplitLayer:
+            for n in conn.nindex_out:
+                nodes[n] = v
+            return True
+        if (type(l) is ConvolutionLayer and l.param.num_group == 1
+                and not l.space_to_depth and not l.s2d_input):
+            p = l.param
+            pg = params[conn.param_key]
+            out = _conv_over_segs(v.segs, pg["wmat"], p.stride,
+                                  p.pad_y, p.pad_x)
+            if "bias" in pg and not l.defer_bias:
+                out = out + pg["bias"].astype(out.dtype).reshape(1, -1, 1, 1)
+            nodes[conn.nindex_out[0]] = out
+            return True
+        if (type(l) in (MaxPoolingLayer, AvgPoolingLayer, SumPoolingLayer)
+                and getattr(l, "deferred_bias_key", None) is None):
+            p = l.param
+            fn = {MaxPoolingLayer: N.max_pool2d, AvgPoolingLayer:
+                  N.avg_pool2d, SumPoolingLayer: N.sum_pool2d}[type(l)]
+            segs = [fn(s, p.kernel_height, p.kernel_width, p.stride,
+                       p.pad_y, p.pad_x) for s in v.segs]
+            if getattr(l, "relu_after", False):
+                from ..layers.activation import apply_relu
+                segs = [apply_relu(s) for s in segs]
+            nodes[conn.nindex_out[0]] = ChSegs(segs)
+            return True
+        return False
+
+    def _forward_fused(self, members: List[int], params, nodes) -> None:
+        """Run a sibling-conv fusion group (``conv_sibling_fuse = 1``) as
+        ONE convolution: the members share an input node and geometry, so
+        their weights concatenate along the output-channel dim (inception
+        modules run three 1x1 reduce convs on the same tensor — fusing
+        turns 3 lane-underfilled MXU calls + 3 weight prefetches into one
+        well-tiled call; autodiff slices the fused wgrad back, so each
+        member keeps its own parameter group, updater state, and
+        checkpoint layout).  Trainer peephole: _fuse_sibling_convs."""
+        from ..layers.base import ChSegs
+        from ..ops import nn as N
+        mconns = [self.connections[j] for j in members]
+        x = nodes[mconns[0].nindex_in[0]]
+        p0 = mconns[0].layer.param
+        w = jnp.concatenate(
+            [params[c.param_key]["wmat"] for c in mconns], axis=0)
+        if isinstance(x, ChSegs):
+            out = _conv_over_segs(x.segs, w, p0.stride, p0.pad_y, p0.pad_x)
+        else:
+            out = N.conv2d(x, w, stride=p0.stride, pad_y=p0.pad_y,
+                           pad_x=p0.pad_x, num_group=1)
+        if "bias" in params[mconns[0].param_key]:
+            b = jnp.concatenate(
+                [params[c.param_key]["bias"] for c in mconns], axis=0)
+            out = out + b.astype(out.dtype).reshape(1, -1, 1, 1)
+        off = 0
+        for c in mconns:
+            co = c.layer.param.num_channel
+            nodes[c.nindex_out[0]] = out[:, off:off + co]
+            off += co
 
     # -- utilities ----------------------------------------------------------
     def node_id(self, name: str) -> int:
@@ -182,6 +279,22 @@ class Network:
             lines.append(f"{i:3d} {conn.layer.type_names[0]:>20s}{share} "
                          f"[{ins} -> {outs}] out={shapes}")
         return "\n".join(lines)
+
+
+def _conv_over_segs(segs, w, stride, pad_y, pad_x):
+    """conv(concat(segs), w) as a sum of K-sliced convs — the consumer
+    side of the virtual concat (autodiff then delivers each segment's
+    input gradient directly, replacing the concat-grad slice-split)."""
+    from ..ops import nn as N
+    out, off = None, 0
+    for s in segs:
+        ci = s.shape[1]
+        o = N.conv2d(s, w[:, off:off + ci], stride=stride,
+                     pad_y=pad_y, pad_x=pad_x, num_group=1)
+        out = o if out is None else out + o
+        off += ci
+    assert off == w.shape[1], (off, w.shape)
+    return out
 
 
 def conn_params(params, conn):

@@ -70,6 +70,10 @@ class NetTrainer:
         # gpipe (fill-drain, grads by autodiff) or 1f1b (interleaved
         # schedule, activation footprint flat in microbatch count)
         self.pipe_schedule = "gpipe"
+        # batch_split = K: run K independent sub-batch chains inside the
+        # step (summed losses) so the scheduler can interleave one
+        # chain's compute into another's prefetch stalls
+        self.batch_split = 1
         self._pipe_partition = None
         # u8 input path: normalization constants applied ON DEVICE when a
         # batch arrives as uint8 (4x less host work + 2-4x less transfer;
@@ -133,6 +137,8 @@ class NetTrainer:
             assert val in ("gpipe", "1f1b"), \
                 f"pipe_schedule = {val}: expected gpipe or 1f1b"
             self.pipe_schedule = val
+        elif name == "batch_split":
+            self.batch_split = int(val)
         elif name == "remat":
             self.remat = int(val)
         elif name == "scale":
@@ -253,6 +259,7 @@ class NetTrainer:
         self._make_shardings()
         self._setup_input_s2d()
         self._reorder_relu_pool()
+        self._fuse_sibling_convs()
         # audit snapshot of the process-global engine options this trainer
         # compiles against (engine.opts is shared; see engine.py) — taken
         # at FIRST TRACE, not here: jit traces lazily, so options changed
@@ -434,6 +441,84 @@ class NetTrainer:
                 self._read_fixups[cnode] = ("bias", cprod.param_key)
                 self._read_fixups[v] = ("relu", cprod.param_key)
 
+    def _fuse_sibling_convs(self):
+        """Peephole (``conv_sibling_fuse = 1``): convolutions that read
+        the SAME node with the SAME geometry (kernel/stride/pad, ungrouped)
+        execute as one fused conv whose weights concatenate along the
+        output-channel dim, with per-member channel slices writing the
+        original output nodes (net._forward_fused).  Inception modules run
+        three 1x1 reduce convs per module on the same input — 27 small
+        lane-underfilled MXU calls + 27 weight/optimizer prefetches across
+        GoogLeNet become 9 well-tiled ones; dgrad of the shared input is
+        one conv instead of a sum of three.  Parameters stay per-layer
+        (autodiff slices the fused wgrad), so the updater, sharding,
+        checkpoints, and get/set_weight are untouched."""
+        self.net.fuse_groups = {}
+        self.net.fuse_skip = frozenset()
+        if engine.opts.conv_sibling_fuse != "1":
+            return
+        from ..layers.conv import ConvolutionLayer
+        conns = self.net.connections
+        layer_uses: Dict[int, int] = {}
+        for c in conns:
+            layer_uses[id(c.layer)] = layer_uses.get(id(c.layer), 0) + 1
+
+        def eligible(c):
+            return (type(c.layer) is ConvolutionLayer
+                    and layer_uses[id(c.layer)] == 1
+                    and len(c.nindex_in) == 1 and len(c.nindex_out) == 1
+                    and c.nindex_in != c.nindex_out
+                    and c.layer.param.num_group == 1
+                    and not c.layer.space_to_depth
+                    and not c.layer.s2d_input
+                    and not c.layer.defer_bias)
+
+        def writers_before(node, before):
+            return tuple(j for j in range(before)
+                         if node in conns[j].nindex_out)
+
+        from ..layers.shape_ops import SplitLayer
+
+        def value_id(v, before):
+            """Hashable identity of node ``v``'s VALUE at position
+            ``before`` — split outputs alias their input (the layer just
+            replicates), so convs reading different split branches of the
+            same tensor still group together."""
+            w = writers_before(v, before)
+            if not w:
+                return ("in", v)
+            j = w[-1]
+            if type(conns[j].layer) is SplitLayer \
+                    and len(conns[j].nindex_in) == 1:
+                return value_id(conns[j].nindex_in[0], j)
+            return ("conn", j)
+
+        groups: Dict[tuple, List[int]] = {}
+        for i, c in enumerate(conns):
+            if not eligible(c):
+                continue
+            if writers_before(c.nindex_out[0], i):
+                # fused members execute at the group head's position; a
+                # member that REBINDS an already-written node would
+                # clobber it before intervening readers ran
+                continue
+            p = c.layer.param
+            key = (value_id(c.nindex_in[0], i), p.kernel_height,
+                   p.kernel_width, p.stride, p.pad_y, p.pad_x, p.no_bias)
+            groups.setdefault(key, []).append(i)
+        fuse, skip = {}, set()
+        for members in groups.values():
+            if len(members) < 2:
+                continue
+            fuse[members[0]] = members
+            skip.update(members[1:])
+        self.net.fuse_groups = fuse
+        self.net.fuse_skip = frozenset(skip)
+        if fuse and not self.silent:
+            print(f"conv_sibling_fuse: {len(fuse)} groups "
+                  f"({sum(len(m) for m in fuse.values())} convs)",
+                  flush=True)
+
     def _setup_input_s2d(self):
         """Wire ``input_s2d = 1``: flag the first conv to consume
         space-to-depth input and record the staging-transform geometry."""
@@ -552,16 +637,10 @@ class NetTrainer:
             self._pipe_partition = (stages, body_end)
         return self._pipe_partition
 
-    def _pipeline_forward(self, params, data, label_vec, *, train, rng,
-                          epoch, mask=None):
-        """Forward through the pipelined body + the post-pipeline loss
-        tail.  Returns (node env over tail nodes, ctx)."""
-        from ..parallel.pipeline import pipeline_apply_hetero
-        from . import pipeline_net
-        stages, body_end = self._pipe_setup()
-        stage_fns = pipeline_net.make_stage_fns(
-            self.net, stages, body_end, train=train, epoch=epoch,
-            loss_scale=self.loss_scale, rng=rng)
+    def _pipe_microbatches(self, data, label_vec, mask):
+        """Shared microbatch prep for the GPipe and 1F1B paths: returns
+        ``(x, extra, b)`` — (n_micro, mb, ...) microbatches, the
+        per-microbatch label-fields/mask pytree, and the batch size."""
         data = self._normalize_input(data)
         b = data.shape[0]
         n_micro = self.pipe_microbatch or 2 * self.mesh.shape["pipe"]
@@ -577,6 +656,19 @@ class NetTrainer:
             if label_vec is not None else {},
             "mask": None if mask is None else mask.reshape(n_micro, mb),
         }
+        return x, extra, b
+
+    def _pipeline_forward(self, params, data, label_vec, *, train, rng,
+                          epoch, mask=None):
+        """Forward through the pipelined body + the post-pipeline loss
+        tail.  Returns (node env over tail nodes, ctx)."""
+        from ..parallel.pipeline import pipeline_apply_hetero
+        from . import pipeline_net
+        stages, body_end = self._pipe_setup()
+        stage_fns = pipeline_net.make_stage_fns(
+            self.net, stages, body_end, train=train, epoch=epoch,
+            loss_scale=self.loss_scale, rng=rng)
+        x, extra, b = self._pipe_microbatches(data, label_vec, mask)
         outs, aux_losses = pipeline_apply_hetero(
             stage_fns, params, x, mesh=self.mesh,
             data_spec=self.batch_shard.spec, extra=extra)
@@ -602,25 +694,10 @@ class NetTrainer:
         from . import pipeline_net
         from .net import conn_params
         stages, body_end = self._pipe_setup()
-        n_stage = self.mesh.shape["pipe"]
         stage_fns = pipeline_net.make_stage_fns(
             self.net, stages, body_end, train=True, epoch=epoch,
             loss_scale=self.loss_scale, rng=rng)
-        data = self._normalize_input(data)
-        b = data.shape[0]
-        n_micro = self.pipe_microbatch or 2 * n_stage
-        assert b % n_micro == 0, (
-            f"pipeline: batch {b} not divisible by pipe_microbatch "
-            f"{n_micro}")
-        x = data.astype(self.dtype).reshape(n_micro, b // n_micro,
-                                            *data.shape[1:])
-        mb = b // n_micro
-        extra = {
-            "fields": {name: label_vec[:, a:b_].reshape(n_micro, mb, -1)
-                       for name, a, b_ in self._label_fields}
-            if label_vec is not None else {},
-            "mask": None if mask is None else mask.reshape(n_micro, mb),
-        }
+        x, extra, b = self._pipe_microbatches(data, label_vec, mask)
         frontier = pipeline_net.frontier_nodes(self.net, body_end)
 
         def tail_loss(p, boundary, extra_m, m):
@@ -775,6 +852,50 @@ class NetTrainer:
                 outs = {nid: as_mat(nodes[nid]).astype(jnp.float32)
                         for nid in eval_ids}
                 return total, (buffers, outs, ctx.diagnostics)
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        if self.batch_split > 1:
+            assert not extras, "batch_split: extra-data inputs unsupported"
+            assert not self.buffers, (
+                "batch_split needs stateless layers (batch_norm running "
+                "stats would chain per sub-batch)")
+            # graph-level software pipelining: run K independent
+            # half-batch chains inside one step and sum their losses —
+            # XLA's latency-hiding scheduler interleaves chain A's
+            # compute into chain B's prefetch stalls (a single serial
+            # stem chain gives it nothing to overlap with).  Requires
+            # stateless layers (no running buffers); dropout keys fold
+            # per chunk, so trajectories differ from unsplit runs the
+            # way two microbatches would.
+            k = self.batch_split
+            assert data.shape[0] % k == 0
+
+            def loss_fn(p):
+                total, outs_parts, diags = None, [], None
+                for j in range(k):
+                    sl = slice(j * data.shape[0] // k,
+                               (j + 1) * data.shape[0] // k)
+                    nodes, _, ctx = self._forward(
+                        p, buffers, data[sl],
+                        None if label_vec is None else label_vec[sl],
+                        (), train=True,
+                        rng=None if rng is None
+                        else jax.random.fold_in(rng, j),
+                        epoch=epoch,
+                        mask=None if mask is None else mask[sl])
+                    assert ctx.losses, \
+                        "network has no loss layer; cannot train"
+                    part = sum(ctx.losses[1:], ctx.losses[0])
+                    total = part if total is None else total + part
+                    outs_parts.append(
+                        {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                         for nid in eval_ids})
+                    diags = ctx.diagnostics
+                outs = {nid: jnp.concatenate(
+                    [op[nid] for op in outs_parts], axis=0)
+                    for nid in eval_ids}
+                return total, (buffers, outs, diags)
 
             return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
@@ -943,7 +1064,6 @@ class NetTrainer:
             lambda a: (a.shape[0], self.batch_size) + a.shape[2:])
 
     def update_many(self, datas, labels, with_outs: bool = False):
-        self._note_engine_opts()
         """Run ``k`` sequential training steps in one device dispatch.
 
         ``datas``: (k, batch, c, h, w); ``labels``: (k, batch, label_width).
@@ -952,6 +1072,7 @@ class NetTrainer:
         node id -> (k, batch, width) stacked outputs for train-metric
         accumulation.
         """
+        self._note_engine_opts()
         datas = self._s2d_transform(self._device_stacked(datas),
                                     stacked=True)
         labels = self._device_stacked(labels, jnp.float32)
@@ -970,11 +1091,11 @@ class NetTrainer:
         return losses
 
     def _build_eval_many(self, k: int, node_ids: Tuple[int, ...]):
-        self._note_engine_opts()
         """One jitted ``lax.scan`` over ``k`` eval batches: one dispatch +
         one D2H per group instead of per batch (VERDICT r3 weak 7 — on a
         tunneled link the per-batch sync made Evaluate disproportionately
         slow next to the scan-batched train path)."""
+        self._note_engine_opts()
         key = (k, node_ids)
         if key in self._eval_many_cache:
             return self._eval_many_cache[key]

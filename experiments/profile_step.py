@@ -18,7 +18,8 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
-def run_traced(tracedir, batch=1024, scan_len=6, model="alexnet"):
+def run_traced(tracedir, batch=1024, scan_len=6, model="alexnet",
+               extra=()):
     from __graft_entry__ import ALEXNET_NET, _make_trainer
     if model == "alexnet":
         conf, shape = ALEXNET_NET, (3, 227, 227)
@@ -28,7 +29,12 @@ def run_traced(tracedir, batch=1024, scan_len=6, model="alexnet"):
             "silent = 1\n"
         shape = (3, 224, 224)
     t = _make_trainer(conf, batch, "tpu",
-                      extra=[("dtype", "bfloat16"), ("eval_train", "0")])
+                      extra=[("dtype", "bfloat16"),
+                             ("eval_train", "0")] + list(extra))
+    if t._s2d_args is not None:
+        from cxxnet_tpu.ops.nn import s2d_staged_shape
+        s, kh, kw, oh, ow, _, _ = t._s2d_args
+        shape = s2d_staged_shape(shape[0], s, kh, kw, oh, ow)
     # generate on DEVICE (the tunneled host link + single host core must
     # not gate the profiled region)
     kd, kl = jax.random.split(jax.random.PRNGKey(0))
@@ -99,7 +105,8 @@ def parse(tracedir, nsteps):
 if __name__ == "__main__":
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
     model = sys.argv[2] if len(sys.argv) > 2 else "alexnet"
+    extra = [tuple(a.split("=", 1)) for a in sys.argv[3:]]
     tracedir = f"/tmp/cxprof_{model}_b{batch}"
     os.system(f"rm -rf {tracedir}")
-    n = run_traced(tracedir, batch, model=model)
+    n = run_traced(tracedir, batch, model=model, extra=extra)
     parse(tracedir, n)

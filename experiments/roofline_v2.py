@@ -172,11 +172,92 @@ def analyze(which="alexnet", batch=1024):
           f"{mfu*100:.1f}% (3x-fwd model-flops convention)")
 
 
+def analyze_transformer(d=2048, L=12, s=4096, b=4, heads=16, vocab=8192,
+                        causal=True):
+    """Per-phase MXU/HBM ceiling for the transformer LM flagship
+    (VERDICT r5 item 4).  Matmul phases tile (M,K,N)-padded like the conv
+    model; flash attention is modeled from its actual kernel matmuls
+    (fwd QK^T + PV; bwd recomputes scores from the saved logsumexp, so
+    hardware MACs are ~3.5x fwd — the r3 kernel profile's convention);
+    layernorm/residual/embedding are HBM floors; optimizer traffic is
+    adam's ~24 B/param."""
+    dh = d // heads
+    T = b * s
+    rows = []
+    t_mxu = t_hbm = 0.0
+    flops_model = 0.0
+
+    def mm(name, phase, g, M, K, N):
+        nonlocal t_mxu
+        t = t_mm(g, M, K, N)
+        rows.append((name, phase, eff(g, M, K, N), t * 1e3, ""))
+        t_mxu += t
+        return t
+
+    def hbm(name, phase, nbytes):
+        nonlocal t_hbm
+        t = nbytes / HBM_BW
+        rows.append((name, phase, 0.0, t * 1e3, "hbm"))
+        t_hbm += t
+
+    # per-layer projections (x L)
+    for nm, K, N in (("qkv", d, 3 * d), ("out_proj", d, d),
+                     ("ffn1", d, 4 * d), ("ffn2", 4 * d, d)):
+        mm(f"{nm} xL", "fwd", L, T, K, N)
+        mm(f"{nm} xL", "dgrad", L, T, N, K)
+        mm(f"{nm} xL", "wgrad", L, K, T, N)
+        flops_model += 2 * L * T * K * N
+    # flash attention: causal halves the score/PV work; bwd = dq + dkdv
+    # kernels, each recomputing scores (r3 profile: ~3.5x fwd MACs total)
+    causal_f = 0.5 if causal else 1.0
+    attn_macs = 2 * b * heads * s * s * dh * causal_f  # QK^T + PV
+    flops_model += 2 * attn_macs
+    t_attn_f = attn_macs / PEAK_MACS / 0.55   # 55% = measured kernel eff
+    t_attn_b = 3.5 * attn_macs / PEAK_MACS / 0.55 - t_attn_f
+    rows.append(("flash xL", "fwd", 0.55, L * t_attn_f * 1e3,
+                 "kernel eff 55%"))
+    rows.append(("flash xL", "bwd", 0.55, L * t_attn_b * 1e3,
+                 "recompute incl"))
+    t_mxu += L * (t_attn_f + t_attn_b)
+    # logits
+    mm("logits", "fwd", 1, T, d, vocab)
+    mm("logits", "dgrad", 1, T, vocab, d)
+    mm("logits", "wgrad", 1, d, T, vocab)
+    flops_model += 2 * T * d * vocab
+    # softmax-xent over vocab: read logits f32-ish twice + write dlogits
+    hbm("xent", "fwd+bwd", 3 * BF16 * T * vocab)
+    # layernorms (2/L + final): fwd read+write, bwd read x,dy write dx
+    hbm("layernorm", "fwd+bwd", (2 * L + 1) * 5 * BF16 * T * d)
+    # residual adds: 2/L, fwd read2+write1, bwd free (identity)
+    hbm("residual", "fwd+bwd", 2 * L * 3 * BF16 * T * d)
+    # embedding gather + scatter-add bwd
+    hbm("embed", "fwd+bwd", 4 * BF16 * T * d)
+
+    n_params = L * (4 * d * d + 2 * d * 4 * d) + vocab * d + s * d
+    t_opt = 24.0 * n_params / HBM_BW
+    t_step = t_mxu + t_hbm + t_opt
+    mfu = 3.0 * flops_model / (t_step * 2 * PEAK_MACS)
+    tok_s = T / t_step
+    print(f"transformer d{d} L{L} s{s} b{b} h{heads} v{vocab}: "
+          f"{n_params/1e6:.1f}M params")
+    print(f"{'op':12s} {'phase':8s} {'MXUeff':>7s} {'ceil ms':>8s}  note")
+    for name, phase, e, ms, note in rows:
+        print(f"{name:12s} {phase:8s} {e*100:6.1f}% {ms:8.3f}  {note}")
+    print(f"  matmul ceiling {t_mxu*1e3:.2f} ms, hbm {t_hbm*1e3:.2f} ms, "
+          f"optimizer {t_opt*1e3:.2f} ms")
+    print(f"  step ceiling {t_step*1e3:.2f} ms -> {tok_s/1e3:.1f}k tok/s, "
+          f"MFU ceiling {mfu*100:.1f}% (3x-fwd model-flops convention)")
+
+
 def kph_kpw(k, s):
     return -(-k // s)
 
 
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "alexnet"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
-    analyze(which, batch)
+    if which == "transformer":
+        kv = dict(kv.split("=") for kv in sys.argv[2:])
+        analyze_transformer(**{k: int(v) for k, v in kv.items()})
+    else:
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+        analyze(which, batch)

@@ -1,0 +1,117 @@
+"""Trace the transformer LM flagship step and print the per-op
+breakdown + timeline occupancy (compute-busy vs copy-blocked), feeding
+the per-phase roofline comparison (roofline_v2.analyze_transformer).
+
+Usage: python experiments/tf_profile.py [d,nlayer,batch] [key=val ...]
+"""
+import glob
+import os
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def run_traced(tracedir, dim=2048, nlayer=12, batch=4, vocab=8192,
+               seq=4096, scan_len=4, extra=()):
+    from __graft_entry__ import _make_trainer
+    from bench import transformer_flops_per_token, peak_flops
+    from cxxnet_tpu.models import transformer
+    import time
+    t = _make_trainer(
+        transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nlayer,
+                    nhead=dim // 64),
+        batch, "tpu", extra=[("dtype", "bfloat16"), ("updater", "adam"),
+                             ("eval_train", "0"),
+                             ("silent", "1")] + list(extra))
+    kd = jax.random.PRNGKey(0)
+    toks = jax.jit(lambda k: jax.random.randint(
+        k, (scan_len, batch, 1, 1, seq), 0, vocab).astype(jnp.float32))(kd)
+    labels = jax.jit(lambda a: jnp.roll(a, -1, axis=-1).reshape(
+        scan_len, batch, seq))(toks)
+    t.start_round(1)
+    np.asarray(t.update_many(toks, labels))
+    t0 = time.perf_counter()
+    np.asarray(t.update_many(toks, labels))
+    wall = (time.perf_counter() - t0) / scan_len * 1e3
+    f_tok = transformer_flops_per_token(vocab, seq, dim, nlayer)
+    tok_s = batch * seq / (wall / 1e3)
+    mfu = 3.0 * f_tok * tok_s / peak_flops(jax.devices()[0].device_kind)
+    print(f"d{dim} L{nlayer} b{batch}: wall {wall:.1f} ms/step "
+          f"{tok_s/1e3:.1f}k tok/s MFU {mfu*100:.1f}%", flush=True)
+    jax.profiler.start_trace(tracedir)
+    np.asarray(t.update_many(toks, labels))
+    jax.profiler.stop_trace()
+    return scan_len
+
+
+def parse(tracedir, nsteps):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = glob.glob(os.path.join(tracedir, "**", "*.xplane.pb"),
+                      recursive=True)
+    xs = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as f:
+        xs.ParseFromString(f.read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name:
+            continue
+        ev_names = plane.event_metadata
+        for line in plane.lines:
+            if "XLA Ops" not in line.name:
+                continue
+            tot = defaultdict(float)
+            cnt = defaultdict(int)
+            comp, copy = [], []
+            for ev in line.events:
+                name = ev_names[ev.metadata_id].name
+                if name.startswith("%while"):
+                    continue
+                dur = ev.duration_ps / 1e9
+                iv = (ev.offset_ps, ev.offset_ps + ev.duration_ps)
+                if ("copy-start" in name or "copy-done" in name
+                        or "slice-start" in name or "slice-done" in name):
+                    copy.append(iv)
+                else:
+                    comp.append(iv)
+                    tot[name.split(" = ")[0]] += dur
+                    cnt[name.split(" = ")[0]] += 1
+
+            def union(ivs):
+                ivs = sorted(ivs)
+                out = 0
+                cs = ce = None
+                for s, e in ivs:
+                    if ce is None or s > ce:
+                        if ce is not None:
+                            out += ce - cs
+                        cs, ce = s, e
+                    else:
+                        ce = max(ce, e)
+                if ce is not None:
+                    out += ce - cs
+                return out / 1e9
+            span = (max(e for _, e in comp + copy)
+                    - min(s for s, _ in comp + copy)) / 1e9
+            cu, au = union(comp), union(comp + copy)
+            print(f"span {span/nsteps:.2f} ms/step | compute-busy "
+                  f"{cu/nsteps:.2f} | copy-blocked {(au-cu)/nsteps:.2f} | "
+                  f"idle {(span-au)/nsteps:.2f}")
+            print(f"--- top compute ops (ms/step over {nsteps}):")
+            for name, d in sorted(tot.items(), key=lambda kv: -kv[1])[:35]:
+                print(f"  {d/nsteps:7.3f} {cnt[name]//nsteps:4d}x  "
+                      f"{name[:90]}")
+
+
+if __name__ == "__main__":
+    cfg = sys.argv[1] if len(sys.argv) > 1 else "2048,12,4"
+    d, nl, b = (int(v) for v in cfg.split(","))
+    extra = [tuple(a.split("=", 1)) for a in sys.argv[2:]]
+    tracedir = f"/tmp/cxprof_tf_d{d}"
+    os.system(f"rm -rf {tracedir}")
+    n = run_traced(tracedir, d, nl, b, extra=extra)
+    parse(tracedir, n)

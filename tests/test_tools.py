@@ -23,6 +23,71 @@ def _run_tool(*args):
                     *args], check=True, cwd=REPO)
 
 
+def test_import_pretrained_torch_roundtrip(tmp_path):
+    """tools/import_pretrained.py maps a torch state_dict onto net layers
+    (the caffe plugin's pretrained-blob import role,
+    caffe_adapter-inl.hpp:172-183) and the saved model reloads with the
+    imported values."""
+    torch = pytest.importorskip("torch")
+    import sys
+    sys.path.insert(0, "/root/repo/tools")
+    from import_pretrained import import_pretrained
+
+    conf = tmp_path / "net.conf"
+    conf.write_text("""
+netconfig=start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  nchannel = 4
+  init_sigma = 0.1
+layer[1->2] = flatten
+layer[2->3] = fullc:f1
+  nhidden = 3
+  init_sigma = 0.1
+layer[3->3] = softmax
+netconfig=end
+input_shape = 2,6,6
+batch_size = 4
+dev = cpu
+eta = 0.1
+metric = error
+silent = 1
+""")
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(2, 4, 3), torch.nn.Flatten(),
+        torch.nn.Linear(4 * 4 * 4, 3))
+    pt = tmp_path / "w.pt"
+    torch.save(tm.state_dict(), str(pt))
+    mp = tmp_path / "map.conf"
+    mp.write_text("""
+c1/wmat = 0.weight
+c1/bias = 0.bias
+f1/wmat = 2.weight
+f1/bias = 2.bias
+""")
+    out = tmp_path / "imported.model"
+    t = import_pretrained(str(conf), str(pt), str(mp), str(out))
+    np.testing.assert_allclose(
+        t.get_weight("c1", "wmat"),
+        tm[0].weight.detach().numpy(), rtol=1e-6)
+    # reload into a fresh trainer: imported values survive the checkpoint
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_file
+    t2 = NetTrainer()
+    for k, v in parse_config_file(str(conf)):
+        t2.set_param(k, v)
+    t2.load_model(str(out))
+    np.testing.assert_allclose(
+        t2.get_weight("f1", "wmat"),
+        tm[2].weight.detach().numpy(), rtol=1e-6)
+    # wrong shape aborts with both shapes in the message
+    bad = tmp_path / "bad.conf"
+    bad.write_text("f1/wmat = 0.weight\n")
+    with pytest.raises(AssertionError, match="shape"):
+        import_pretrained(str(conf), str(pt), str(bad),
+                          str(tmp_path / "x.model"))
+
+
 def test_partition_counts_and_pack(tmp_path):
     root, lst = _fake_jpegs(tmp_path, n=11)
     out = tmp_path / "parts"

@@ -690,6 +690,206 @@ def test_relu_pool_reorder_self_loop_matches():
         set_engine_option("pool_relu_reorder", old)
 
 
+INCEPTION_CONF = """
+netconfig=start
+layer[0->s] = conv:stem
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+  init_sigma = 0.1
+layer[s->s] = relu
+layer[s->a,b,c,d] = split
+layer[a->a1] = conv:b0
+  kernel_size = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[a1->a1] = relu
+layer[b->b1] = conv:r3
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[b1->b1] = relu
+layer[b1->b2] = conv:c3
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+  init_sigma = 0.1
+layer[b2->b2] = relu
+layer[c->c1] = conv:r5
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[c1->c1] = relu
+layer[c1->c2] = conv:c5
+  kernel_size = 5
+  nchannel = 8
+  pad = 2
+  init_sigma = 0.1
+layer[c2->c2] = relu
+layer[d->d1] = max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+layer[d1->d2] = conv:proj
+  kernel_size = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[d2->d2] = relu
+layer[a1,b2,c2,d2->cc] = ch_concat
+layer[cc->e,f,g] = split
+layer[e->e1] = conv:m2_1x1
+  kernel_size = 1
+  nchannel = 8
+  init_sigma = 0.1
+layer[e1->e1] = relu
+layer[f->f1] = conv:m2_r3
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[f1->f1] = relu
+layer[f1->f2] = conv:m2_c3
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+  init_sigma = 0.1
+layer[f2->f2] = relu
+layer[g->g1] = max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+layer[g1->g2] = conv:m2_proj
+  kernel_size = 1
+  nchannel = 4
+  init_sigma = 0.1
+layer[g2->g2] = relu
+layer[e1,f2,g2->cc2] = ch_concat
+layer[cc2->gp] = avg_pooling
+  kernel_size = 12
+  stride = 1
+layer[gp->fl] = flatten
+layer[fl->fc] = fullc:f1
+  nhidden = 4
+  init_sigma = 0.1
+layer[fc->fc] = softmax
+netconfig=end
+input_shape = 3,12,12
+batch_size = 16
+dev = cpu
+eta = 0.05
+momentum = 0.9
+metric = error
+silent = 1
+"""
+
+
+def test_conv_sibling_fuse_matches():
+    """conv_sibling_fuse=1 runs the inception 1x1 reduce convs as one
+    fused conv + slices; the trajectory must match the unfused path
+    (identical math up to fp reduction order)."""
+    from cxxnet_tpu.engine import opts, set_engine_option
+    old = opts.conv_sibling_fuse
+    try:
+        set_engine_option("conv_sibling_fuse", "0")
+        ref = make_trainer(INCEPTION_CONF)
+        set_engine_option("conv_sibling_fuse", "1")
+        fu = make_trainer(INCEPTION_CONF)
+        assert fu.net.fuse_groups, "sibling fuse did not fire"
+        assert sum(len(m) for m in fu.net.fuse_groups.values()) == 5, \
+            fu.net.fuse_groups  # {b0,r3,r5} on the stem + {m2_1x1,m2_r3}
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                fu.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
+        rnd = np.random.RandomState(11)
+        for _ in range(4):
+            x = rnd.randn(16, 3, 12, 12).astype(np.float32)
+            y = (rnd.rand(16) * 4).astype(np.float32)
+            b = DataBatch(data=x, label=y.reshape(16, 1),
+                          index=np.arange(16, dtype=np.uint32))
+            ref.update(b)
+            fu.update(b)
+            np.testing.assert_allclose(
+                np.asarray(fu._last_loss), np.asarray(ref._last_loss),
+                rtol=1e-5)
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                np.testing.assert_allclose(
+                    np.asarray(fu.params[pkey][tag]), np.asarray(v),
+                    rtol=1e-4, atol=1e-6, err_msg=f"{pkey}/{tag}")
+    finally:
+        set_engine_option("conv_sibling_fuse", old)
+
+
+@pytest.mark.parametrize("fuse", ["0", "1"])
+def test_concat_virtual_matches(fuse):
+    """concat_virtual=1 keeps ch_concat values as segment tuples (convs
+    consume K-sliced sums, pools/split map per segment, unaware
+    consumers materialize); trajectory must match the materializing
+    path, alone and composed with conv_sibling_fuse."""
+    from cxxnet_tpu.engine import opts, set_engine_option
+    old_v, old_f = opts.concat_virtual, opts.conv_sibling_fuse
+    try:
+        set_engine_option("concat_virtual", "0")
+        set_engine_option("conv_sibling_fuse", "0")
+        ref = make_trainer(INCEPTION_CONF)
+        set_engine_option("concat_virtual", "1")
+        set_engine_option("conv_sibling_fuse", fuse)
+        vt = make_trainer(INCEPTION_CONF)
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                vt.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
+        rnd = np.random.RandomState(13)
+        for _ in range(3):
+            x = rnd.randn(16, 3, 12, 12).astype(np.float32)
+            y = (rnd.rand(16) * 4).astype(np.float32)
+            b = DataBatch(data=x, label=y.reshape(16, 1),
+                          index=np.arange(16, dtype=np.uint32))
+            ref.update(b)
+            vt.update(b)
+            np.testing.assert_allclose(
+                np.asarray(vt._last_loss), np.asarray(ref._last_loss),
+                rtol=1e-5)
+        for pkey, group in ref.params.items():
+            for tag, v in group.items():
+                np.testing.assert_allclose(
+                    np.asarray(vt.params[pkey][tag]), np.asarray(v),
+                    rtol=1e-4, atol=1e-6, err_msg=f"{pkey}/{tag}")
+    finally:
+        set_engine_option("concat_virtual", old_v)
+        set_engine_option("conv_sibling_fuse", old_f)
+
+
+def test_batch_split_matches():
+    """batch_split=K runs K independent sub-batch chains with summed
+    losses; on a dropout-free net the trajectory matches the unsplit
+    path (same math, summation order aside)."""
+    ref = make_trainer(S2D_CONF)
+    sp = make_trainer(S2D_CONF, extra=[("batch_split", "2")])
+    for pkey, group in ref.params.items():
+        for tag, v in group.items():
+            sp.set_weight(np.asarray(v), pkey.split("-", 1)[1], tag)
+    rnd = np.random.RandomState(5)
+    for _ in range(4):
+        x = rnd.randn(16, 3, 21, 21).astype(np.float32)
+        y = (rnd.rand(16) * 4).astype(np.float32)
+        b = DataBatch(data=x, label=y.reshape(16, 1),
+                      index=np.arange(16, dtype=np.uint32))
+        ref.update(b)
+        sp.update(b)
+        np.testing.assert_allclose(
+            np.asarray(sp._last_loss), np.asarray(ref._last_loss),
+            rtol=1e-5)
+        # eval outs concatenate in sub-batch order
+        np.testing.assert_allclose(
+            np.asarray(sp._last_outs[ref.eval_node_ids[0]]),
+            np.asarray(ref._last_outs[ref.eval_node_ids[0]]),
+            rtol=1e-4, atol=1e-6)
+    for pkey, group in ref.params.items():
+        for tag, v in group.items():
+            np.testing.assert_allclose(
+                np.asarray(sp.params[pkey][tag]), np.asarray(v),
+                rtol=1e-4, atol=1e-6, err_msg=f"{pkey}/{tag}")
+
+
 def test_extract_feature_on_deferred_nodes():
     """extract_feature on nodes inside a deferred conv->relu->pool block
     must return the undeferred values: the relu node physically holds the

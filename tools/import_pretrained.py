@@ -1,0 +1,82 @@
+"""Import externally-trained weights into a cxxnet_tpu model checkpoint.
+
+The reference's caffe plugin had two roles: a differential-testing oracle
+(covered here by ``plugin/torch_adapter``) and a path for
+externally-trained parameters to enter a net — the wrapped caffe layer
+carried its trained blobs as weights
+(``src/plugin/caffe_adapter-inl.hpp:172-183``, blob exposure ``:45-66``).
+This tool is the TPU-native equivalent of that second role: the graph
+stays native, and external weights flow in through the public
+get/set_weight surface, then save as a normal model checkpoint loadable
+with ``model_in =`` / ``continue = 1`` / ``task = finetune``.
+
+Usage::
+
+  python tools/import_pretrained.py net.conf weights.pt map.conf out.model
+
+``weights`` may be a torch state_dict (``.pt``/``.pth``, loaded
+CPU-side) or a numpy ``.npz``.  ``map.conf`` uses the framework's
+key=value syntax, one line per tensor::
+
+  conv1/wmat = features.0.weight
+  conv1/bias = features.0.bias
+  fc6/wmat   = classifier.1.weight
+
+Layouts line up with torch natively: conv ``wmat`` is
+(out, in/group, kh, kw) = ``torch.nn.Conv2d.weight``; fullc ``wmat`` is
+(nhidden, nin) = ``torch.nn.Linear.weight``.  Shapes must match exactly
+— mismatches abort with both shapes printed.
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def load_external(path):
+    if path.endswith(".npz"):
+        return dict(np.load(path))
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):  # a full module was saved
+        sd = sd.state_dict()
+    return {k: v.detach().numpy() for k, v in sd.items()}
+
+
+def import_pretrained(conf_path, weights_path, map_path, out_path,
+                      dev="cpu"):
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_file
+
+    t = NetTrainer()
+    for k, v in parse_config_file(conf_path):
+        t.set_param(k, v)
+    t.set_param("dev", dev)
+    t.init_model()
+
+    ext = load_external(weights_path)
+    n = 0
+    for k, v in parse_config_file(map_path):
+        layer, _, tag = k.partition("/")
+        assert tag, f"map line {k!r}: expected <layer>/<tag> = <ext key>"
+        assert v in ext, (
+            f"{v!r} not in {weights_path} "
+            f"(available: {sorted(ext)[:8]}...)")
+        src = np.asarray(ext[v])
+        cur = t.get_weight(layer, tag)
+        assert tuple(src.shape) == tuple(cur.shape), (
+            f"{layer}/{tag}: external {v} has shape {tuple(src.shape)}, "
+            f"net expects {tuple(cur.shape)}")
+        t.set_weight(src.astype(cur.dtype), layer, tag)
+        n += 1
+    t.save_model(out_path)
+    print(f"imported {n} tensors from {weights_path} -> {out_path}")
+    return t
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 5:
+        print(__doc__)
+        sys.exit(1)
+    import_pretrained(*sys.argv[1:5])
