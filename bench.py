@@ -340,13 +340,20 @@ def main() -> None:
               f"MFU(dev)={dev_mfu * 100:.1f}%", file=sys.stderr)
     except Exception as e:  # tracing must never break the headline
         print(f"bench: device-time trace failed: {e}", file=sys.stderr)
-    del t, datas, labels, losses  # free HBM before the secondary benches
+    # free HBM before the secondary benches: the trainer sits in reference
+    # cycles (step closures <-> trainer), so an explicit collect is what
+    # actually releases the device buffers — without it the transformer/
+    # GoogLeNet/VGG secondaries die with RESOURCE_EXHAUSTED
+    import gc
+    del t, datas, labels, pending
+    gc.collect()
     try:
         lenet_ms = bench_lenet()
         print(f"bench: LeNet b512 step={lenet_ms:.2f}ms "
               f"(BASELINE secondary metric)", file=sys.stderr)
     except Exception as e:  # secondary metric must never break the headline
         print(f"bench: LeNet secondary metric failed: {e}", file=sys.stderr)
+    gc.collect()
     try:
         tok_s = bench_transformer()
         print(f"bench: transformer LM s4096 {tok_s:.0f} tokens/sec "
@@ -354,6 +361,7 @@ def main() -> None:
     except Exception as e:
         print(f"bench: transformer secondary metric failed: {e}",
               file=sys.stderr)
+    gc.collect()
     try:
         g_ips, g_mfu = bench_googlenet()
         print(f"bench: GoogLeNet b256 {g_ips:.0f} imgs/sec "
@@ -362,6 +370,7 @@ def main() -> None:
     except Exception as e:
         print(f"bench: GoogLeNet secondary metric failed: {e}",
               file=sys.stderr)
+    gc.collect()
     try:
         vgg_ips, vgg_mfu = bench_vgg()
         print(f"bench: VGG-16 b128 {vgg_ips:.0f} imgs/sec "
