@@ -219,9 +219,13 @@ def bench_transformer() -> float:
     from __graft_entry__ import _make_trainer
     vocab, seq, dim, nlayer = 8192, 4096, 2048, 12
     batch, scan_len = 4, 4  # b6/L16 exceed HBM at this width
+    # dh=128 heads: the MXU is 128 wide, so 64-wide heads leave half the
+    # array idle in every attention matmul AND double the per-head softmax
+    # VPU work; measured 2.06x on the whole attention layer
+    # (experiments/fa_tune.py: 24.0 -> 11.7 ms/layer fwd+bwd)
     t = _make_trainer(
         transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nlayer,
-                    nhead=dim // 64),
+                    nhead=dim // 128),
         batch, "tpu", extra=[("dtype", "bfloat16"), ("updater", "adam"),
                              ("eval_train", "0"), ("silent", "1")])
     import jax

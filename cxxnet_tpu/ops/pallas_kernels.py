@@ -859,11 +859,22 @@ def conv_wgrad_s2d_pallas(x: jnp.ndarray, dy: jnp.ndarray, *, kh: int,
 
 NEG_INF = -1e30
 
+# NOTE: grid dimension_semantics annotations were swept on v5e
+# (experiments/fa_tune.py) and measured exactly neutral, so the kernels
+# ship unannotated.  Do not add PARALLEL to the q-block grid dim of the
+# forward kernel without restructuring lse: its (1, 1, s) output block is
+# shared across q-block programs, which a megacore split would corrupt.
 
-def _fa_blocks(s_len):
-    """Block sizes: big blocks amortize per-program overhead; must divide
-    the sequence length and satisfy the (8, 128) tile minimum."""
-    bq, bk = 512, 1024
+
+def _fa_blocks(s_len, d=64):
+    """Block sizes: big blocks amortize per-program overhead and k/v
+    re-fetches; must divide the sequence length and satisfy the (8, 128)
+    tile minimum.  (1024, 1024) won the v5e sweep at s4096 for both head
+    widths (experiments/fa_tune.py: fwd 6.84 vs 7.66 ms at dh64, 3.26 vs
+    3.66 at dh128, bwd equal-or-better); scores stay ~8 MB f32 in VMEM.
+    Wider heads (d > 128, unswept) keep the old (512, 1024) shape so the
+    bwd kernels' block-sized f32 intermediates stay inside VMEM."""
+    bq, bk = (1024, 1024) if d <= 128 else (512, 1024)
     while bq > 128 and s_len % bq != 0:
         bq //= 2
     while bk > 128 and s_len % bk != 0:
@@ -1007,7 +1018,7 @@ def _fa_specs(nbh, s_len, d, bq, bk):
 
 def _fa_fwd(q3, k3, v3, scale, causal, interpret):
     nbh, s_len, d = q3.shape
-    bq, bk = _fa_blocks(s_len)
+    bq, bk = _fa_blocks(s_len, d)
     q_spec, k_spec, row_spec = _fa_specs(nbh, s_len, d, bq, bk)
     kern = functools.partial(_fa_fwd_kernel, scale=scale, causal=causal,
                              bq=bq, bk=bk)
@@ -1028,7 +1039,7 @@ def _fa_bwd(q3, k3, v3, o3, lse, g3, scale, causal, interpret):
     nbh, s_len, d = q3.shape
     delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]  # (nbh, 1, s)
-    bq, bk = _fa_blocks(s_len)
+    bq, bk = _fa_blocks(s_len, d)
     q_spec, k_spec, row_spec = _fa_specs(nbh, s_len, d, bq, bk)
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, scale=scale, causal=causal,

@@ -25,7 +25,7 @@ def run_traced(tracedir, dim=2048, nlayer=12, batch=4, vocab=8192,
     import time
     t = _make_trainer(
         transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nlayer,
-                    nhead=dim // 64),
+                    nhead=dim // 128),
         batch, "tpu", extra=[("dtype", "bfloat16"), ("updater", "adam"),
                              ("eval_train", "0"),
                              ("silent", "1")] + list(extra))
@@ -68,7 +68,12 @@ def parse(tracedir, nsteps):
             cnt = defaultdict(int)
             comp, copy = [], []
             for ev in line.events:
-                name = ev_names[ev.metadata_id].name
+                # classify on the OP name only: the full text includes
+                # operand names, so matching "copy-done" against it
+                # misclassifies compute fusions that CONSUME async-copy
+                # results as copies (this inflated "copy-blocked" from
+                # ~10 to 358 ms/step on the d2048 flagship)
+                name = ev_names[ev.metadata_id].name.split(" = ")[0]
                 if name.startswith("%while"):
                     continue
                 dur = ev.duration_ps / 1e9
@@ -78,8 +83,8 @@ def parse(tracedir, nsteps):
                     copy.append(iv)
                 else:
                     comp.append(iv)
-                    tot[name.split(" = ")[0]] += dur
-                    cnt[name.split(" = ")[0]] += 1
+                    tot[name] += dur
+                    cnt[name] += 1
 
             def union(ivs):
                 ivs = sorted(ivs)

@@ -240,16 +240,22 @@ def test_flash_attention_matches_dense():
 
 
 def test_flash_attention_asymmetric_blocks():
-    """Sequence lengths hitting the bq!=bk path (512/1024 blocks)."""
+    """The bq!=bk path stays correct (the v5e-tuned default is square
+    1024x1024, so asymmetric blocks are exercised via override)."""
     from cxxnet_tpu.ops import pallas_kernels as pk
     from cxxnet_tpu.parallel.ring import dense_attention
-    assert pk._fa_blocks(8192) == (512, 1024)
+    assert pk._fa_blocks(8192) == (1024, 1024)
     assert pk._fa_blocks(512) == (512, 512)
     assert pk._fa_blocks(128) == (128, 128)
     rnd = np.random.RandomState(1)
     q, k, v = (jnp.asarray(rnd.randn(1, 1, 1024, 32).astype(np.float32) * 0.5)
                for _ in range(3))
-    out = pk.flash_attention(q, k, v, True)
+    old_blocks = pk._fa_blocks
+    try:
+        pk._fa_blocks = lambda s, d=64: (256, 512)  # asymmetric, multi-block
+        out = pk.flash_attention(q, k, v, True)
+    finally:
+        pk._fa_blocks = old_blocks
     # chunked reference at this length
     import cxxnet_tpu.parallel.ring as ring
     old = ring.CHUNKED_ATTN_THRESHOLD
