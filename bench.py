@@ -144,17 +144,20 @@ def bench_vgg():
 def bench_googlenet():
     """Inception-zoo secondary: GoogLeNet b256 full train step under the
     round-5 lowering stack (input_s2d stem, sibling-fused 1x1 reduce
-    convs, band LRN, relu->pool reorder).  Returns
+    convs, conv-form band LRN, virtual concat, relu->pool reorder, and
+    two overlapped sub-batch chains via batch_split=2).  Returns
     ``(imgs_per_sec, mfu)`` from double-buffered dispatches."""
     from cxxnet_tpu.engine import opts, set_engine_option
     batch, scan_len = 256, 6
-    old_fuse = opts.conv_sibling_fuse
+    saved = {k: getattr(opts, k)
+             for k in ("conv_sibling_fuse", "pallas_lrn", "concat_virtual")}
     try:
         return _bench_googlenet_inner(batch, scan_len)
     finally:
         # engine options are process-global: restore even on failure so a
         # tunnel hiccup here can't silently change what bench_vgg measures
-        set_engine_option("conv_sibling_fuse", old_fuse)
+        for k, v in saved.items():
+            set_engine_option(k, v)
 
 
 def _bench_googlenet_inner(batch, scan_len):
@@ -167,7 +170,10 @@ def _bench_googlenet_inner(batch, scan_len):
         "silent = 1\n",
         batch, "tpu", extra=[("dtype", "bfloat16"), ("eval_train", "0"),
                              ("input_s2d", "1"),
-                             ("conv_sibling_fuse", "1")])
+                             ("conv_sibling_fuse", "1"),
+                             ("pallas_lrn", "bandconv"),
+                             ("concat_virtual", "1"),
+                             ("batch_split", "2")])
     from cxxnet_tpu.ops.nn import s2d_staged_shape
     s, kh, kw, oh, ow, _, _ = t._s2d_args
     shape = (scan_len, batch) + s2d_staged_shape(3, s, kh, kw, oh, ow)

@@ -15,10 +15,15 @@ one mid-run does not retrace already-compiled steps.
 
 | key         | values                     | meaning                        |
 |-------------|----------------------------|--------------------------------|
-| pool_bwd    | sas (default), eq, gather  | max-pool backward: XLA select- |
-|             |                            | and-scatter (one argmax per    |
+| pool_bwd    | sas (default), eq, gather, | max-pool backward: XLA select- |
+|             | auto                       | and-scatter (one argmax per    |
 |             |                            | window) vs exact mshadow all-  |
-|             |                            | ties unpool (eq == gather)     |
+|             |                            | ties unpool (eq == gather);    |
+|             |                            | auto = all-ties Pallas where   |
+|             |                            | the kernel takes the shape,    |
+|             |                            | SAS elsewhere (measured ~equal |
+|             |                            | to sas on GoogLeNet; semantics |
+|             |                            | vary per pool at ties)         |
 | pool_layout | nchw (default), chwn, hwcn | pool compute layout; hwcn =    |
 |             |                            | native-layout Pallas kernels   |
 |             |                            | (implies all-ties backward)    |
@@ -61,7 +66,7 @@ import os
 _DEFS = {
     # name: (env var, default, valid values); flash_attn's env var is an
     # inverted bool, special-cased in _Options.__init__
-    "pool_bwd": ("CXXNET_POOL_BWD", "sas", ("sas", "eq", "gather")),
+    "pool_bwd": ("CXXNET_POOL_BWD", "sas", ("sas", "eq", "gather", "auto")),
     "pool_layout": ("CXXNET_POOL_LAYOUT", "nchw", ("nchw", "chwn", "hwcn")),
     "fast_wgrad": ("CXXNET_FAST_WGRAD", "s2d",
                    ("s2d", "hwcn", "pallas", "off")),

@@ -415,9 +415,18 @@ def _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x):
 
 def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
                pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
+    from .pallas_kernels import max_pool_hwcn_supported
     hwcn_ok = (pad_y == 0 and pad_x == 0 and ksize_y == ksize_x
-               and jax.default_backend() == "tpu" and x.shape[0] % 128 == 0)
-    want_allties = opts.pool_layout == "hwcn" or opts.pool_bwd in ("eq", "gather")
+               and jax.default_backend() == "tpu"
+               and x.shape[0] % 128 == 0
+               and max_pool_hwcn_supported(x.shape, stride))
+    # "auto": Pallas all-ties where the hwcn kernel takes the shape, SAS
+    # elsewhere (measured ~equal to pure SAS on the GoogLeNet stage pools,
+    # BASELINE.md round 5).  Gradient SEMANTICS then vary per pool
+    # (all-ties vs one-winner at ties) — an explicit opt-in, never the
+    # default.
+    want_allties = (opts.pool_layout == "hwcn"
+                    or opts.pool_bwd in ("eq", "gather", "auto"))
     if want_allties and hwcn_ok:
         # Pallas kernels in XLA's native (H, W, C, N) activation layout:
         # exact mshadow all-ties backward, ~15x faster than the XLA
@@ -430,7 +439,9 @@ def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
         # (padded pools, partial batches, CPU) — gradient semantics must
         # not flip with batch divisibility mid-run
         return _max_pool_eq(x, ksize_y, ksize_x, stride, pad_y, pad_x)
-    if opts.pool_layout == "chwn" and opts.pool_bwd == "sas":
+    # ("auto" reaching this line means the Pallas kernel declined the
+    # shape, so the lowering IS SAS — honor the chwn layout choice)
+    if opts.pool_layout == "chwn" and opts.pool_bwd in ("sas", "auto"):
         xt = jnp.transpose(x, (1, 2, 3, 0))
         # reuse the NCHW padding/window logic by viewing (C, H, W, N) as
         # (N', C', H, W) with batch'=C and channel'=H: reduce_window only

@@ -392,6 +392,37 @@ def _lrn_hwcn_bwd_res(nsize, alpha, beta, knorm, res, g):
 lrn_pallas_hwcn.defvjp(_lrn_hwcn_fwd_res, _lrn_hwcn_bwd_res)
 
 
+# VMEM budget for the multi-row backward's channel tile: estimates over
+# ~13.4 MB crashed the Mosaic compile (GoogLeNet c832/w14, c480/w32)
+_MR_BWD_VMEM_CAP = 12 << 20
+
+
+def _pick_cb(c: int, per_cb_bytes: int, cap: int) -> int:
+    """Largest channel tile dividing c that fits the VMEM budget, else the
+    smallest legal tile.  Mosaic requires a block dim be a multiple of 8
+    OR the full array dim — the old halving loop could land on e.g. 60
+    for c=480 (GoogLeNet stage-3 pool), which is neither, and failed TPU
+    compilation."""
+    legal = [cb for cb in range(1, c + 1)
+             if c % cb == 0 and (cb == c or cb % 8 == 0)]
+    return next((cb for cb in reversed(legal)
+                 if cb * per_cb_bytes <= cap), legal[0])
+
+
+def max_pool_hwcn_supported(shape, s: int) -> bool:
+    """Shapes the hwcn pool kernel compiles for on TPU: the lane dim must
+    be full tiles for the bitcast boundary, and the tile _pick_cb chooses
+    for the multi-row backward (hb = 3*s rows) must actually fit its
+    budget — when none does, the fallback over-allocates and Mosaic
+    crashes (measured: c64/w224 k2s2 fails, c32/w147 and c64/w112
+    compile)."""
+    n, c, h, w = shape
+    if n % 128 != 0:
+        return False
+    per = w * 128 * 12 * (3 * s)
+    return _pick_cb(c, per, _MR_BWD_VMEM_CAP) * per <= _MR_BWD_VMEM_CAP
+
+
 # --------------------------------------------------------------------------
 # Max pooling in the native (H, W, C, N) layout.  Same bitcast-boundary
 # trick as lrn_pallas_hwcn.  Forward: grid (C, N, OH) with k one-row input
@@ -528,9 +559,7 @@ def _mp_hwcn_fwd(xt, k, s, interpret):
     # clipped tail windows (even w, k=3, s=2) exceeds ceil(w/s)
     wpad = max(-(-w // s), (k - 1) // s + ow) * s
     nb = 128 if n % 128 == 0 else n
-    cb = c
-    while (w * cb * nb * 4) * (k + 2) > (10 << 20) and cb % 2 == 0:
-        cb //= 2
+    cb = _pick_cb(c, (w * nb * 4) * (k + 2), 10 << 20)
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
 
     x_specs = [
@@ -568,13 +597,10 @@ def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
         rel_last = (hb - 1 - (k - 1) + (s - 1)) // s - rel0
         nref = rel_last + ncand
         # dominant VMEM per (w, cb, nb) plane: in/out blocks + the f32
-        # row accumulators and their stack (~12 block-planes per row);
-        # floor at one sublane tile (16) — this exact formula is the
-        # measured-working configuration (52.8 ms AlexNet eq step)
-        cb = c
-        while w * cb * nb * 12 * hb > (14 << 20) and cb % 2 == 0 \
-                and cb > 16:
-            cb //= 2
+        # row accumulators and their stack (~12 block-planes per row).
+        # Under _MR_BWD_VMEM_CAP every proven AlexNet shape picks the same
+        # tile as the original 14 MB halving loop did
+        cb = _pick_cb(c, w * nb * 12 * hb, _MR_BWD_VMEM_CAP)
 
         def p_imap(i):
             def imap(bc, bn, bh):
@@ -598,9 +624,7 @@ def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
             interpret=interpret,
         )(xt, *([pt] * nref), *([dpt] * nref))
 
-    cb = c
-    while (w * cb * nb * 4) * (2 * ncand + 4) > (10 << 20) and cb % 2 == 0:
-        cb //= 2
+    cb = _pick_cb(c, (w * nb * 4) * (2 * ncand + 4), 10 << 20)
 
     def cand_imap(cand):
         def imap(bc, bn, hrow):

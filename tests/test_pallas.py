@@ -354,3 +354,27 @@ def test_lrn_band_matches_xla(nsize, beta):
         lambda v: (_xla_lrn(v, nsize, .001, beta, 1.) ** 2).sum())(x)
     np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_pool_channel_tile_legality():
+    """_pick_cb must return a tile that divides c and is a multiple of 8
+    (or c itself): the old halving loop landed on 60 for GoogLeNet's
+    480-channel stage-3 pool, which Mosaic rejects."""
+    from cxxnet_tpu.ops.pallas_kernels import (_pick_cb,
+                                               max_pool_hwcn_supported)
+    for c in (480, 240, 832, 96, 256, 192, 512, 64, 528):
+        for per in (28 * 128 * 4 * 8, 14 * 128 * 12 * 6, 55 * 128 * 4 * 5):
+            cb = _pick_cb(c, per, 10 << 20)
+            assert c % cb == 0
+            assert cb == c or cb % 8 == 0
+    # every GoogLeNet/AlexNet pool geometry is supported; w=224 (no legal
+    # tile fits the multi-row backward budget) is not
+    for shape, s in [((128, 64, 112, 112), 2),
+                     ((128, 192, 56, 56), 2),
+                     ((128, 480, 28, 28), 2),
+                     ((128, 832, 14, 14), 2),
+                     ((128, 96, 55, 55), 2),
+                     ((128, 256, 27, 27), 2)]:
+        assert max_pool_hwcn_supported(shape, s), shape
+    assert not max_pool_hwcn_supported((128, 64, 224, 224), 2)
+    assert not max_pool_hwcn_supported((100, 64, 28, 28), 2)  # lanes
