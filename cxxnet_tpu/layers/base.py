@@ -193,11 +193,14 @@ class LayerParam:
                 a = self.init_uniform
             return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
         if self.random_type == 2:
-            if self.num_hidden > 0:
-                sigma = float(np.sqrt(2.0 / self.num_hidden))
-            else:
-                fan = self.num_channel * self.kernel_width * self.kernel_height
-                sigma = float(np.sqrt(2.0 / fan)) if fan > 0 else 0.01
+            # kaiming: sqrt(2 / fan_IN) — the in_num callers pass is the
+            # per-group fan-in (conv: cin/g*kh*kw, fullc: input dim).
+            # The old formula read num_hidden/num_channel, i.e. fan_OUT,
+            # which under-scales exactly the deep relu stacks kaiming
+            # exists for: GoogLeNet activations decayed ~3x per stage
+            # (0.5 -> 2e-3 by inception 4a) and the logits sank below
+            # bf16 noise, making the loss data-independent at chance.
+            sigma = float(np.sqrt(2.0 / in_num)) if in_num > 0 else 0.01
             return sigma * jax.random.normal(key, shape, dtype)
         raise ValueError(f"unsupported random_type {self.random_type}")
 

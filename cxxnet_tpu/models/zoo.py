@@ -135,11 +135,11 @@ input_shape = 3,227,227
 
 def _conv_relu(lines: List[str], bottom: str, top: str, name: str,
                nchannel: int, ksize: int, pad: int = 0,
-               stride: int = 1) -> str:
+               stride: int = 1, init: str = "xavier") -> str:
     lines += [f"layer[{bottom}->{top}] = conv:{name}",
               f"  kernel_size = {ksize}",
               f"  nchannel = {nchannel}",
-              "  random_type = xavier"]
+              f"  random_type = {init}"]
     if stride != 1:
         lines.append(f"  stride = {stride}")
     if pad:
@@ -150,7 +150,8 @@ def _conv_relu(lines: List[str], bottom: str, top: str, name: str,
 
 def _inception(lines: List[str], name: str, bottom: str,
                n1x1: int, n3x3red: int, n3x3: int,
-               n5x5red: int, n5x5: int, proj: int) -> str:
+               n5x5red: int, n5x5: int, proj: int,
+               init: str = "xavier") -> str:
     """Append a GoogLeNet inception module; returns the top node name.
 
     4-way split -> {1x1, 1x1->3x3, 1x1->5x5, pool->1x1} -> ch_concat (the
@@ -160,22 +161,26 @@ def _inception(lines: List[str], name: str, bottom: str,
     """
     sp = [f"{name}_sp{i}" for i in range(4)]
     lines.append(f"layer[{bottom}->{','.join(sp)}] = split")
-    b0 = _conv_relu(lines, sp[0], f"{name}_b0", f"{name}_1x1", n1x1, 1)
-    _conv_relu(lines, sp[1], f"{name}_r3", f"{name}_3x3r", n3x3red, 1)
+    b0 = _conv_relu(lines, sp[0], f"{name}_b0", f"{name}_1x1", n1x1, 1,
+                    init=init)
+    _conv_relu(lines, sp[1], f"{name}_r3", f"{name}_3x3r", n3x3red, 1,
+               init=init)
     b1 = _conv_relu(lines, f"{name}_r3", f"{name}_b1", f"{name}_3x3",
-                    n3x3, 3, pad=1)
-    _conv_relu(lines, sp[2], f"{name}_r5", f"{name}_5x5r", n5x5red, 1)
+                    n3x3, 3, pad=1, init=init)
+    _conv_relu(lines, sp[2], f"{name}_r5", f"{name}_5x5r", n5x5red, 1,
+               init=init)
     b2 = _conv_relu(lines, f"{name}_r5", f"{name}_b2", f"{name}_5x5",
-                    n5x5, 5, pad=2)
+                    n5x5, 5, pad=2, init=init)
     lines += [f"layer[{sp[3]}->{name}_p] = max_pooling",
               "  kernel_size = 3", "  stride = 1", "  pad = 1"]
-    b3 = _conv_relu(lines, f"{name}_p", f"{name}_b3", f"{name}_proj", proj, 1)
+    b3 = _conv_relu(lines, f"{name}_p", f"{name}_b3", f"{name}_proj",
+                    proj, 1, init=init)
     lines.append(f"layer[{b0},{b1},{b2},{b3}->{name}] = ch_concat")
     return name
 
 
 def _aux_head(lines: List[str], name: str, bottom: str,
-              num_class: int) -> str:
+              num_class: int, init: str = "xavier") -> str:
     """GoogLeNet v1 auxiliary classifier: avgpool5/s3 -> 1x1 conv 128 ->
     fc1024 -> dropout 0.7 -> fc -> softmax at grad_scale 0.3.  Returns the
     trunk-continuation node.  The aux gradient injection is what lets the
@@ -185,7 +190,8 @@ def _aux_head(lines: List[str], name: str, bottom: str,
     lines += [f"layer[{bottom}->{main},{aux}] = split",
               f"layer[{aux}->{name}_ap] = avg_pooling",
               "  kernel_size = 5", "  stride = 3"]
-    _conv_relu(lines, f"{name}_ap", f"{name}_cv", f"{name}_conv", 128, 1)
+    _conv_relu(lines, f"{name}_ap", f"{name}_cv", f"{name}_conv", 128, 1,
+               init=init)
     lines += [f"layer[{name}_cv->{name}_fl] = flatten",
               f"layer[{name}_fl->{name}_fc1] = fullc:{name}_fc1",
               "  nhidden = 1024",
@@ -199,7 +205,8 @@ def _aux_head(lines: List[str], name: str, bottom: str,
     return main
 
 
-def googlenet(num_class: int = 1000, aux_heads: bool = True) -> str:
+def googlenet(num_class: int = 1000, aux_heads: bool = True,
+              init: str = "xavier") -> str:
     """GoogLeNet v1: 9 inception modules + the two auxiliary classifiers
     (after i4a and i4d, grad_scale 0.3 — the v1 recipe).
 
@@ -207,36 +214,36 @@ def googlenet(num_class: int = 1000, aux_heads: bool = True) -> str:
     config-to-port); channel plan is the canonical v1 table.
     """
     lines = ["netconfig=start"]
-    _conv_relu(lines, "0", "c1", "conv1", 64, 7, pad=3, stride=2)
+    _conv_relu(lines, "0", "c1", "conv1", 64, 7, pad=3, stride=2, init=init)
     lines += ["layer[c1->p1] = max_pooling",
               "  kernel_size = 3", "  stride = 2",
               "layer[p1->n1] = lrn",
               "  local_size = 5", "  alpha = 0.0001", "  beta = 0.75",
               "  knorm = 1"]
-    _conv_relu(lines, "n1", "c2r", "conv2r", 64, 1)
-    _conv_relu(lines, "c2r", "c2", "conv2", 192, 3, pad=1)
+    _conv_relu(lines, "n1", "c2r", "conv2r", 64, 1, init=init)
+    _conv_relu(lines, "c2r", "c2", "conv2", 192, 3, pad=1, init=init)
     lines += ["layer[c2->n2] = lrn",
               "  local_size = 5", "  alpha = 0.0001", "  beta = 0.75",
               "  knorm = 1",
               "layer[n2->p2] = max_pooling",
               "  kernel_size = 3", "  stride = 2"]
-    top = _inception(lines, "i3a", "p2", 64, 96, 128, 16, 32, 32)
-    top = _inception(lines, "i3b", top, 128, 128, 192, 32, 96, 64)
+    top = _inception(lines, "i3a", "p2", 64, 96, 128, 16, 32, 32, init=init)
+    top = _inception(lines, "i3b", top, 128, 128, 192, 32, 96, 64, init=init)
     lines += [f"layer[{top}->p3] = max_pooling",
               "  kernel_size = 3", "  stride = 2"]
-    top = _inception(lines, "i4a", "p3", 192, 96, 208, 16, 48, 64)
+    top = _inception(lines, "i4a", "p3", 192, 96, 208, 16, 48, 64, init=init)
     if aux_heads:
-        top = _aux_head(lines, "aux1", top, num_class)
-    top = _inception(lines, "i4b", top, 160, 112, 224, 24, 64, 64)
-    top = _inception(lines, "i4c", top, 128, 128, 256, 24, 64, 64)
-    top = _inception(lines, "i4d", top, 112, 144, 288, 32, 64, 64)
+        top = _aux_head(lines, "aux1", top, num_class, init=init)
+    top = _inception(lines, "i4b", top, 160, 112, 224, 24, 64, 64, init=init)
+    top = _inception(lines, "i4c", top, 128, 128, 256, 24, 64, 64, init=init)
+    top = _inception(lines, "i4d", top, 112, 144, 288, 32, 64, 64, init=init)
     if aux_heads:
-        top = _aux_head(lines, "aux2", top, num_class)
-    top = _inception(lines, "i4e", top, 256, 160, 320, 32, 128, 128)
+        top = _aux_head(lines, "aux2", top, num_class, init=init)
+    top = _inception(lines, "i4e", top, 256, 160, 320, 32, 128, 128, init=init)
     lines += [f"layer[{top}->p4] = max_pooling",
               "  kernel_size = 3", "  stride = 2"]
-    top = _inception(lines, "i5a", "p4", 256, 160, 320, 32, 128, 128)
-    top = _inception(lines, "i5b", top, 384, 192, 384, 48, 128, 128)
+    top = _inception(lines, "i5a", "p4", 256, 160, 320, 32, 128, 128, init=init)
+    top = _inception(lines, "i5b", top, 384, 192, 384, 48, 128, 128, init=init)
     lines += [f"layer[{top}->gp] = avg_pooling",
               "  kernel_size = 7", "  stride = 1",
               "layer[gp->gp] = dropout",
@@ -246,7 +253,11 @@ def googlenet(num_class: int = 1000, aux_heads: bool = True) -> str:
               f"  nhidden = {num_class}",
               "layer[fc->fc] = softmax",
               "netconfig=end",
-              "input_shape = 3,224,224"]
+              "input_shape = 3,224,224",
+              # global default so the fullc heads (aux fc1/fc2, final fc)
+              # follow the chosen init too; per-layer conv settings above
+              # are explicit
+              f"random_type = {init}"]
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,147 @@
+"""GoogLeNet synthetic-STREAM convergence at b128 (VERDICT r5 #8).
+
+The round-4 record only showed fixed-set memorization; the stream runs
+(fresh samples every step) sat at chance for 600 steps at eta=0.002.
+This sweep finds hyperparameters under which the stream loss actually
+declines (<6.0 by step ~600 from ln(1000)=6.9078) and appends the
+winning curve to CONVERGENCE.jsonl.
+
+Data: per-class oriented gratings + noise (see gen() comment),
+REGENERATED per dispatch group from a folded key — every batch is new,
+so declining loss is generalization to the class distribution, not
+memorization.
+
+What made it converge (in order of discovery): sgd at every LR, adam at
+1e-3, and LR/momentum warmup all sat at EXACT chance on the
+block-prototype stream with a data-independent loss curve; activation
+probing showed the trunk attenuating 3x per stage under xavier (logits
+below bf16 noise by inception 5).  Two escapes were then found and both
+are recorded in CONVERGENCE.jsonl: (a) adam at 3e-4 converges even
+under xavier on the block stream (0.32 @ 600 — adaptive step sizes
+compensate the tiny gradients; 1e-3 does not), and (b) kaiming init
+makes plain SGD converge — after fixing rand_init_weight's kaiming,
+which used fan_OUT instead of fan_in (layers/base.py), exactly
+under-scaling the deep relu stacks kaiming exists for.
+
+Usage: python experiments/gl_stream.py [eta ...]   (default sweep)
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def stream_curve(eta, steps=600, batch=128, nclass=1000,
+                 shape=(3, 224, 224), group=8, extra=(), init="xavier"):
+    from __graft_entry__ import _make_trainer
+    from cxxnet_tpu.models import googlenet
+    t = _make_trainer(
+        googlenet(init=init) + "metric = error\n"
+        f"eta = {eta}\nmomentum = 0.9\n",
+        batch, "tpu", extra=[("dtype", "bfloat16"), ("eval_train", "0"),
+                             ("silent", "1"), *extra])
+    kp = jax.random.PRNGKey(7)
+
+    # class signal: per-class oriented grating (frequency/phase/channel
+    # amplitudes).  The 8x8 block-prototype family used for memorization
+    # is conv-HOSTILE as a stream task: the class signal is a global
+    # template with locally identical statistics everywhere, so a linear
+    # probe solves it in <100 steps (measured: loss 0.0) while AlexNet
+    # AND GoogLeNet sit at exact chance for 600 steps under every
+    # optimizer/init/LR tried.  Gratings are locally detectable by the
+    # oriented-edge features conv stems learn first.
+    kf1, kf2, kph, kam = jax.random.split(kp, 4)
+    fy = jax.random.uniform(kf1, (nclass,), minval=0.05, maxval=1.5)
+    fx = jax.random.uniform(kf2, (nclass,), minval=0.05, maxval=1.5)
+    ph = jax.random.uniform(kph, (nclass,), maxval=2 * np.pi)
+    amp = jax.random.uniform(kam, (nclass, shape[0]), minval=-1.0,
+                             maxval=1.0)
+    yy = jnp.arange(shape[1], dtype=jnp.float32)[:, None]
+    xx = jnp.arange(shape[2], dtype=jnp.float32)[None, :]
+
+    @jax.jit
+    def gen(kg):
+        kl, kn = jax.random.split(kg)
+        labels = jax.random.randint(kl, (group, batch), 0, nclass)
+        wave = jnp.sin(fy[labels][..., None, None] * yy
+                       + fx[labels][..., None, None] * xx
+                       + ph[labels][..., None, None])
+        pat = amp[labels][..., :, None, None] * wave[:, :, None, :, :]
+        noise = jax.random.uniform(kn, (group, batch) + shape) * 0.25
+        return ((pat + noise).astype(jnp.bfloat16),
+                labels[..., None].astype(jnp.float32))
+
+    t.start_round(1)
+    curve = []
+    for it in range(steps // group):
+        datas, labs = gen(jax.random.fold_in(kp, 1000 + it))
+        losses = np.asarray(t.update_many(datas, labs))
+        curve.extend(float(x) for x in losses)
+        if not np.isfinite(curve[-1]):
+            break
+    return curve
+
+
+def main():
+    # spec: "eta" (sgd), "adam,eta", "k<eta>" (kaiming sgd), "ak<eta>"
+    # (kaiming adam), or "eta+warm" (factor-schedule LR warmup x2/75
+    # steps + momentum ramp 0.5->0.9).  Defaults = the recorded winners.
+    specs = sys.argv[1:] or ["k0.01", "ak0.001"]
+    best = None
+    for spec in specs:
+        extra = []
+        init = "xavier"
+        name = spec
+        if spec.startswith("adam,"):
+            eta = float(spec.split(",")[1])
+            extra = [("updater", "adam")]
+        elif spec.startswith("ak"):  # kaiming + adam
+            eta = float(spec[2:])
+            init = "kaiming"
+            extra = [("updater", "adam")]
+        elif spec.startswith("k"):  # kaiming init + sgd
+            eta = float(spec[1:])
+            init = "kaiming"
+        elif spec.endswith("+warm"):
+            eta = float(spec[:-5])
+            extra = [("eta", str(eta / 16)), ("lr:schedule", "factor"),
+                     ("lr:factor", "2"), ("lr:step", "75"),
+                     ("momentum_schedule", "1"),
+                     ("base_momentum", "0.5"),
+                     ("final_momentum", "0.9"),
+                     ("saturation_epoch", "300")]
+        else:
+            eta = float(spec)
+        t0 = time.perf_counter()
+        c = stream_curve(eta, extra=extra, init=init)
+        marks = {s: round(c[s - 1], 4)
+                 for s in (1, 100, 200, 300, 400, 500, 600) if s <= len(c)}
+        print(f"{name}: {marks} ({time.perf_counter() - t0:.0f}s)",
+              flush=True)
+        if np.isfinite(c[-1]) and (best is None or c[-1] < best[1][-1]):
+            best = (name, c)
+    if best is None:
+        print("every spec diverged; nothing to record", flush=True)
+        return
+    spec, c = best
+    if c[-1] < 6.0:
+        from experiments.convergence import record
+        marks = sorted(set([1, 100, 200, 300, 400, 500, 600]))
+        record("imagenet-googlenet",
+               f"synthetic 1000-class STREAM (per-class oriented "
+               f"gratings + noise, fresh samples every step), b128, "
+               f"{spec} (k = kaiming init), TPU v5e, bf16",
+               "loss (main + 0.3*aux heads) by step (generalization)",
+               {s: round(c[s - 1], 4) for s in marks if s <= len(c)})
+    else:
+        print(f"no spec reached <6.0 (best {spec}: {c[-1]:.4f}); not "
+              "recording", flush=True)
+
+
+if __name__ == "__main__":
+    main()

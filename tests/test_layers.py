@@ -550,3 +550,20 @@ def test_engine_options_config_keys():
             set_engine_option("pool_bwd", "bogus")
     finally:
         set_engine_option("pool_bwd", old)
+
+
+def test_kaiming_uses_fan_in():
+    """kaiming sigma must be sqrt(2/fan_in): the fan_OUT formula it
+    shipped with under-scales deep relu stacks (GoogLeNet trunk
+    activations decayed ~3x per stage and the loss went data-independent
+    at chance; experiments/gl_stream.py)."""
+    import numpy as np
+    from cxxnet_tpu.layers.base import LayerParam
+    p = LayerParam()
+    p.set_param("random_type", "kaiming")
+    p.set_param("nhidden", 1000)      # fan_out - must NOT drive sigma
+    key = jax.random.PRNGKey(0)
+    fan_in = 50
+    w = np.asarray(p.rand_init_weight(key, (1000, fan_in), fan_in, 1000))
+    want = np.sqrt(2.0 / fan_in)
+    assert abs(w.std() - want) / want < 0.05, (w.std(), want)
