@@ -53,3 +53,50 @@ def test_s2d_emit_iterator_matches_device_transform():
     it = S2DEmitIterator(ListIter([b8]), (2, 5, 5, 10, 10, 2, 2))
     it.before_first()
     np.testing.assert_array_equal(it.next().data, x8)
+
+
+def test_wrap_s2d_splices_beneath_deepest_buffer():
+    """main.LearnTask._wrap_s2d must place the s2d emitter BENEATH the
+    deepest buffering stage (threadbuffer/membuffer) so the transform
+    runs on the producer thread, and wrap the chain directly when no
+    buffer exists (round-4 splice logic, previously untested)."""
+    from cxxnet_tpu.io.data import IIterator
+    from cxxnet_tpu.io.iter_proc import (S2DEmitIterator,
+                                         ThreadBufferIterator)
+    from cxxnet_tpu.main import LearnTask
+
+    class Base(IIterator):
+        base = None
+
+    class Stage(IIterator):
+        def __init__(self, base):
+            self.base = base
+
+    task = LearnTask.__new__(LearnTask)
+
+    class FakeNet:
+        _s2d_args = (2, 5, 5, 9, 9, 0, 0)
+    task.net = FakeNet()
+
+    # chain: Stage(ThreadBuffer(Stage(Base))) -> emitter under the buffer
+    base = Base()
+    chain = Stage(ThreadBufferIterator.__new__(ThreadBufferIterator))
+    chain.base.base = Stage(base)
+    out = task._wrap_s2d(chain)
+    assert out is chain
+    assert isinstance(chain.base.base, S2DEmitIterator)
+    assert chain.base.base.base is not base  # still the inner Stage
+    assert isinstance(chain.base.base.base, Stage)
+
+    # no buffering stage: wrap the whole chain
+    plain = Stage(Base())
+    out = task._wrap_s2d(plain)
+    assert isinstance(out, S2DEmitIterator)
+    assert out.base is plain
+
+    # s2d off: untouched
+    class PlainNet:
+        _s2d_args = None
+    task.net = PlainNet()
+    it = Stage(Base())
+    assert task._wrap_s2d(it) is it
