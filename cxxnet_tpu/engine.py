@@ -47,6 +47,12 @@ one mid-run does not retrace already-compiled steps.
 |             |                            | per segment (layers/base.py    |
 |             |                            | ChSegs)                        |
 | flash_attn  | 1 (default), 0             | Pallas flash attention on TPU  |
+| pallas_ln   | 0 (default), 1             | Pallas layernorm kernel in the |
+|             |                            | sequence stack — an HBM        |
+|             |                            | trade: pins x per site for its |
+|             |                            | backward (the d2048 flagship   |
+|             |                            | OOMs by 0.8G), vs XLA fusions  |
+|             |                            | measured 1.9 ms/site there     |
 
 ``opts`` is a PROCESS-GLOBAL singleton: every trainer in the process
 reads it at trace time, so two trainers with different lowering options
@@ -79,6 +85,7 @@ _DEFS = {
     "conv_sibling_fuse": ("CXXNET_CONV_SIBLING_FUSE", "0", ("1", "0")),
     "concat_virtual": ("CXXNET_CONCAT_VIRTUAL", "0", ("1", "0")),
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),
+    "pallas_ln": ("CXXNET_PALLAS_LN", "0", ("1", "0")),
 }
 
 

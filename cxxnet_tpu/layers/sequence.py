@@ -147,9 +147,32 @@ class LayerNormLayer(Layer):
     def forward(self, params, buffers, inputs, ctx):
         self.check_n_inputs(inputs, 1)
         x = inputs[0]
+        n, c, s, d = x.shape
+        rows = n * c * s
+        from ..engine import opts
+        from ..ops import pallas_kernels as pk
+        if (pk._on_tpu() and opts.pallas_ln == "1"  # opt-in: costs HBM (saved x)
+                and pk.layernorm_pallas_supported(rows, d)):
+            # single-sweep Pallas kernel: the XLA lowering left
+            # ~1.9 ms/site convert_reduce fusions in the d2048 step
+            # (47.9 ms over 25 sites vs 0.094 ms standalone — the fusion
+            # chains behind an operand copy); see pallas_kernels.py
+            y = pk.layernorm_pallas(x.reshape(rows, d), params["wmat"],
+                                    params["bias"], self.eps)
+            return [y.reshape(x.shape)], buffers
         x32 = x.astype(jnp.float32)
         mean = x32.mean(axis=-1, keepdims=True)
-        var = jnp.square(x32 - mean).mean(axis=-1, keepdims=True)
+        if x.dtype == jnp.bfloat16:
+            # single-pass moments (E[x^2]-E[x]^2): one reduce fusion over
+            # x instead of two chained ones — measured -19 ms/step on the
+            # d2048 flagship.  The formula cancels for rows with
+            # mean/std beyond ~2^11, but bf16 INPUTS quantize away at
+            # mean/std ~2^8 already, so nothing is lost for bf16 models;
+            # f32 inputs keep the cancellation-robust two-pass form.
+            m2 = jnp.square(x32).mean(axis=-1, keepdims=True)
+            var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+        else:
+            var = jnp.square(x32 - mean).mean(axis=-1, keepdims=True)
         y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
         y = y * params["wmat"].astype(jnp.float32) \
             + params["bias"].astype(jnp.float32)

@@ -378,3 +378,28 @@ def test_pool_channel_tile_legality():
         assert max_pool_hwcn_supported(shape, s), shape
     assert not max_pool_hwcn_supported((128, 64, 224, 224), 2)
     assert not max_pool_hwcn_supported((100, 64, 28, 28), 2)  # lanes
+
+
+def test_layernorm_pallas_matches_xla():
+    """layernorm_pallas fwd + all three grads == the XLA formulation
+    (sequence.LayerNormLayer's fallback path)."""
+    from cxxnet_tpu.ops.pallas_kernels import layernorm_pallas
+    rnd = np.random.RandomState(0)
+    x = jnp.asarray(rnd.randn(64, 256).astype(np.float32))
+    g = jnp.asarray(rnd.rand(256).astype(np.float32) + 0.5)
+    b = jnp.asarray(rnd.randn(256).astype(np.float32))
+
+    def ref(x, g, b):
+        mean = x.mean(-1, keepdims=True)
+        var = jnp.square(x - mean).mean(-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    y1 = layernorm_pallas(x, g, b, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(ref(x, g, b)),
+                               rtol=1e-5, atol=1e-5)
+    dy = jnp.asarray(rnd.randn(64, 256).astype(np.float32))
+    g1 = jax.vjp(lambda *a: layernorm_pallas(*a, 1e-5, True), x, g, b)[1](dy)
+    g2 = jax.vjp(ref, x, g, b)[1](dy)
+    for a, bb, nm in zip(g1, g2, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5, err_msg=nm)
