@@ -259,3 +259,24 @@ def test_tiny_vgg_trains():
         t.update(b)
         losses.append(float(t._last_loss))
     assert losses[-1] < losses[0] * 0.8
+
+
+def test_googlenet_init_threading():
+    """googlenet(init=...) must reach every conv (per-layer key) AND,
+    since round 5, the fullc heads via the global default line.  (The
+    recorded kaiming stream-convergence runs predate the global line —
+    their fc heads were gaussian-0.01, as CONVERGENCE.jsonl states;
+    this test pins the builder's CURRENT contract.)"""
+    from cxxnet_tpu.models import googlenet
+    conf = googlenet(init="kaiming")
+    assert "xavier" not in conf
+    # per-layer sites only (indented); the global tail line is separate
+    per_layer = sum(1 for ln in conf.splitlines()
+                    if ln != ln.lstrip()
+                    and ln.strip() == "random_type = kaiming")
+    assert per_layer == 59, per_layer  # 57 trunk/inception + 2 aux convs
+    # the global default (outside netconfig) covers the fc heads
+    tail = conf.split("netconfig=end", 1)[1]
+    assert "random_type = kaiming" in tail
+    # default stays xavier
+    assert "random_type = xavier" in googlenet()
