@@ -912,6 +912,39 @@ def _causal_mask(s, i, j, bq, bk):
     return jnp.where(qpos >= kpos, s, NEG_INF)
 
 
+def _fa_fwd_init(acc, m, l):
+    acc[...] = jnp.zeros_like(acc)
+    m[...] = jnp.full_like(m, NEG_INF)
+    l[...] = jnp.zeros_like(l)
+
+
+def _fa_fwd_step(i, j, q_ref, k_ref, v_ref, acc, m, l, *, scale, causal,
+                 bq, bk):
+    """One online-softmax block update — the SINGLE copy of the forward
+    math, shared by the dense and triangular-grid kernels."""
+    # keep matmul operands in the input dtype (bf16 hits the MXU's fast
+    # path); accumulate in f32 via preferred_element_type
+    qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
+    s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, i, j, bq, bk)
+    m_prev = m[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l[...] = l[...] * corr + p.sum(axis=-1, keepdims=True)
+    acc[...] = acc[...] * corr + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m[...] = m_new
+
+
+def _fa_fwd_emit(i, o_ref, lse_ref, acc, m, l, bq):
+    o_ref[0] = (acc[...] / l[...]).astype(o_ref.dtype)
+    lse_ref[0, 0, pl.ds(i * bq, bq)] = (m[...] + jnp.log(l[...]))[:, 0]
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
                    *, scale, causal, bq, bk):
     i, j = pl.program_id(1), pl.program_id(2)
@@ -919,36 +952,46 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
 
     @pl.when(j == 0)
     def _():
-        acc[...] = jnp.zeros_like(acc)
-        m[...] = jnp.full_like(m, NEG_INF)
-        l[...] = jnp.zeros_like(l)
+        _fa_fwd_init(acc, m, l)
 
     # causal: blocks strictly above the diagonal contribute nothing
     live = (i * bq + bq - 1 >= j * bk) if causal else (j >= 0)
 
     @pl.when(live)
     def _():
-        # keep matmul operands in the input dtype (bf16 hits the MXU's fast
-        # path); accumulate in f32 via preferred_element_type
-        qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
-        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, i, j, bq, bk)
-        m_prev = m[...]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l[...] = l[...] * corr + p.sum(axis=-1, keepdims=True)
-        acc[...] = acc[...] * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m[...] = m_new
+        _fa_fwd_step(i, j, q_ref, k_ref, v_ref, acc, m, l, scale=scale,
+                     causal=causal, bq=bq, bk=bk)
 
     @pl.when(j == nk - 1)
     def _():
-        o_ref[0] = (acc[...] / l[...]).astype(o_ref.dtype)
-        lse_ref[0, 0, pl.ds(i * bq, bq)] = (m[...] + jnp.log(l[...]))[:, 0]
+        _fa_fwd_emit(i, o_ref, lse_ref, acc, m, l, bq)
+
+
+def _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+             scale, causal, bq, bk):
+    """Recompute p and ds for one block pair — the SINGLE copy of the
+    backward score math, shared by dq/dkv in both grid forms."""
+    qb, kb = q_ref[0], k_ref[0]
+    s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, i, j, bq, bk)
+    p = jnp.exp(s - lse_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+    dob = do_ref[0]
+    dp = jax.lax.dot_general(dob, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]) * scale
+    return p, ds, dob, qb, kb
+
+
+def _fa_dq_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_acc, *, scale, causal, bq, bk):
+    _, ds, _, _, kb = _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref,
+                               lse_ref, delta_ref, scale=scale,
+                               causal=causal, bq=bq, bk=bk)
+    dq_acc[...] += jax.lax.dot_general(
+        ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -964,24 +1007,26 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _():
-        qb, kb = q_ref[0], k_ref[0]
-        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, i, j, bq, bk)
-        p = jnp.exp(s - lse_ref[0, 0, pl.ds(i * bq, bq)][:, None])
-        dob = do_ref[0]
-        dp = jax.lax.dot_general(dob, v_ref[0],
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]) * scale
-        dq_acc[...] += jax.lax.dot_general(
-            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _fa_dq_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dq_acc, scale=scale, causal=causal,
+                    bq=bq, bk=bk)
 
     @pl.when(j == nk - 1)
     def _():
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_dkv_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_acc, dv_acc, *, scale, causal, bq, bk):
+    p, ds, dob, qb, _ = _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref,
+                                 lse_ref, delta_ref, scale=scale,
+                                 causal=causal, bq=bq, bk=bk)
+    dv_acc[...] += jax.lax.dot_general(
+        p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_acc[...] += jax.lax.dot_general(
+        ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -998,23 +1043,9 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _():
-        qb, kb = q_ref[0], k_ref[0]
-        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, i, j, bq, bk)
-        p = jnp.exp(s - lse_ref[0, 0, pl.ds(i * bq, bq)][:, None])
-        dob = do_ref[0]
-        dv_acc[...] += jax.lax.dot_general(
-            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(dob, v_ref[0],
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]) * scale
-        dk_acc[...] += jax.lax.dot_general(
-            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _fa_dkv_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dk_acc, dv_acc, scale=scale,
+                     causal=causal, bq=bq, bk=bk)
 
     @pl.when(i == nq - 1)
     def _():
@@ -1031,6 +1062,90 @@ def flash_attention_available(s_len: int, d: int) -> bool:
     return pltpu is not None and s_len % 128 == 0 and d <= 256
 
 
+def _fa_tri_pairs(nq, nk, bq, bk, order):
+    """Live (i, j) block pairs of the causal triangle, as int32 arrays.
+    order="ij": i-major (dq/fwd: j accumulates within a row);
+    order="ji": j-major (dkv: i accumulates within a column).  Dead
+    blocks (i*bq+bq-1 < j*bk) are EXCLUDED from the grid entirely, so
+    neither their DMA nor their program overhead is paid — with equal
+    1024-blocks at s4096 that is 6 of 16 programs."""
+    import numpy as _np
+    pairs = [(i, j) for i in range(nq) for j in range(nk)
+             if i * bq + bq - 1 >= j * bk]
+    if order == "ji":
+        pairs.sort(key=lambda ij: (ij[1], ij[0]))
+    ii = _np.asarray([p[0] for p in pairs], _np.int32)
+    jj = _np.asarray([p[1] for p in pairs], _np.int32)
+    return jnp.asarray(ii), jnp.asarray(jj)
+
+
+def _fa_fwd_kernel_tri(ii_ref, jj_ref, q_ref, k_ref, v_ref, o_ref,
+                       lse_ref, acc, m, l, *, scale, bq, bk):
+    t = pl.program_id(1)
+    i, j = ii_ref[t], jj_ref[t]
+    jlast = (i * bq + bq - 1) // bk
+
+    @pl.when(j == 0)
+    def _():
+        _fa_fwd_init(acc, m, l)
+
+    _fa_fwd_step(i, j, q_ref, k_ref, v_ref, acc, m, l, scale=scale,
+                 causal=True, bq=bq, bk=bk)
+
+    @pl.when(j == jlast)
+    def _():
+        _fa_fwd_emit(i, o_ref, lse_ref, acc, m, l, bq)
+
+
+def _fa_dq_kernel_tri(ii_ref, jj_ref, q_ref, k_ref, v_ref, do_ref,
+                      lse_ref, delta_ref, dq_ref, dq_acc, *, scale, bq, bk):
+    t = pl.program_id(1)
+    i, j = ii_ref[t], jj_ref[t]
+    jlast = (i * bq + bq - 1) // bk
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    _fa_dq_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_acc, scale=scale, causal=True, bq=bq, bk=bk)
+
+    @pl.when(j == jlast)
+    def _():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel_tri(ii_ref, jj_ref, q_ref, k_ref, v_ref, do_ref,
+                       lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                       *, scale, bq, bk, nq):
+    t = pl.program_id(1)
+    i, j = ii_ref[t], jj_ref[t]
+    ifirst = (j * bk) // bq
+
+    @pl.when(i == ifirst)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    _fa_dkv_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_acc, dv_acc, scale=scale, causal=True, bq=bq, bk=bk)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_tri_specs(s_len, d, bq, bk):
+    """Block specs for the (nbh, T) triangular grid: index maps read the
+    live pair arrays from scalar prefetch (convention: index_map(*grid,
+    *scalar_refs))."""
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, t, ii, jj: (b, ii[t], 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, t, ii, jj: (b, jj[t], 0))
+    row_spec = pl.BlockSpec((1, 1, s_len), lambda b, t, ii, jj: (b, 0, 0))
+    return q_spec, k_spec, row_spec
+
+
 def _fa_specs(nbh, s_len, d, bq, bk):
     # row vectors (lse, delta) ride as whole (1, s) blocks pinned per batch
     # row: a (1, bq) block would violate the (8, 128) tile minimum
@@ -1043,6 +1158,25 @@ def _fa_specs(nbh, s_len, d, bq, bk):
 def _fa_fwd(q3, k3, v3, scale, causal, interpret):
     nbh, s_len, d = q3.shape
     bq, bk = _fa_blocks(s_len, d)
+    if causal:
+        # triangular grid: dead above-diagonal blocks are excluded from
+        # the grid, so neither their k/v DMA nor program overhead is paid
+        # (with equal 1024-blocks at s4096: 6 of 16 programs).  Also runs
+        # under interpret so the CPU parity tests cover this path.
+        ii, jj = _fa_tri_pairs(s_len // bq, s_len // bk, bq, bk, "ij")
+        q_spec, k_spec, row_spec = _fa_tri_specs(s_len, d, bq, bk)
+        gs = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=(nbh, ii.shape[0]),
+            in_specs=[q_spec, k_spec, k_spec],
+            out_specs=[q_spec, row_spec],
+            scratch_shapes=_scratch((bq, d), (bq, 1), (bq, 1)))
+        kern = functools.partial(_fa_fwd_kernel_tri, scale=scale,
+                                 bq=bq, bk=bk)
+        return pl.pallas_call(
+            kern, grid_spec=gs, interpret=interpret,
+            out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                       jax.ShapeDtypeStruct((nbh, 1, s_len), jnp.float32)],
+        )(ii, jj, q3, k3, v3)
     q_spec, k_spec, row_spec = _fa_specs(nbh, s_len, d, bq, bk)
     kern = functools.partial(_fa_fwd_kernel, scale=scale, causal=causal,
                              bq=bq, bk=bk)
@@ -1064,6 +1198,35 @@ def _fa_bwd(q3, k3, v3, o3, lse, g3, scale, causal, interpret):
     delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]  # (nbh, 1, s)
     bq, bk = _fa_blocks(s_len, d)
+    if causal:
+        nq, nk = s_len // bq, s_len // bk
+        q_spec, k_spec, row_spec = _fa_tri_specs(s_len, d, bq, bk)
+        ii, jj = _fa_tri_pairs(nq, nk, bq, bk, "ij")
+        gs = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=(nbh, ii.shape[0]),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            scratch_shapes=_scratch((bq, d)))
+        dq = pl.pallas_call(
+            functools.partial(_fa_dq_kernel_tri, scale=scale, bq=bq,
+                              bk=bk),
+            grid_spec=gs, interpret=interpret,
+            out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        )(ii, jj, q3, k3, v3, g3, lse, delta)
+        ii2, jj2 = _fa_tri_pairs(nq, nk, bq, bk, "ji")
+        gs2 = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=(nbh, ii2.shape[0]),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            out_specs=[k_spec, k_spec],
+            scratch_shapes=_scratch((bk, d), (bk, d)))
+        dk, dv = pl.pallas_call(
+            functools.partial(_fa_dkv_kernel_tri, scale=scale, bq=bq,
+                              bk=bk, nq=nq),
+            grid_spec=gs2, interpret=interpret,
+            out_shape=[jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+                       jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+        )(ii2, jj2, q3, k3, v3, g3, lse, delta)
+        return dq, dk, dv
     q_spec, k_spec, row_spec = _fa_specs(nbh, s_len, d, bq, bk)
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, scale=scale, causal=causal,
