@@ -47,12 +47,25 @@ one mid-run does not retrace already-compiled steps.
 |             |                            | per segment (layers/base.py    |
 |             |                            | ChSegs)                        |
 | flash_attn  | 1 (default), 0             | Pallas flash attention on TPU  |
-| pallas_ln   | 0 (default), 1             | Pallas layernorm kernel in the |
-|             |                            | sequence stack — an HBM        |
-|             |                            | trade: pins x per site for its |
-|             |                            | backward (the d2048 flagship   |
-|             |                            | OOMs by 0.8G), vs XLA fusions  |
-|             |                            | measured 1.9 ms/site there     |
+| pallas_ln   | 1 (default), x, 0          | Pallas layernorm kernel in the |
+|             |                            | sequence stack.  Default-on    |
+|             |                            | since round 6: the backward is |
+|             |                            | output-derived (residuals =    |
+|             |                            | y/gamma/beta/rstd, no extra    |
+|             |                            | (rows, d) buffer — the round-5 |
+|             |                            | kernel saved x and OOM'd the   |
+|             |                            | d2048 flagship by 0.8G).       |
+|             |                            | "x" = input-saving backward    |
+|             |                            | (precision escape hatch, pins  |
+|             |                            | x).  See doc/pallas_ln.md      |
+| fused_update| 0 (default), 1             | one-sweep Pallas adam step for |
+|             |                            | big bf16-master tensors: folds |
+|             |                            | the bf16->f32 grad convert and |
+|             |                            | master->bf16 cast into the     |
+|             |                            | update kernel (attacks the     |
+|             |                            | ~47.5 ms convert_reduce line). |
+|             |                            | Opt-in until a TPU session     |
+|             |                            | A/Bs it                        |
 
 ``opts`` is a PROCESS-GLOBAL singleton: every trainer in the process
 reads it at trace time, so two trainers with different lowering options
@@ -85,7 +98,8 @@ _DEFS = {
     "conv_sibling_fuse": ("CXXNET_CONV_SIBLING_FUSE", "0", ("1", "0")),
     "concat_virtual": ("CXXNET_CONCAT_VIRTUAL", "0", ("1", "0")),
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),
-    "pallas_ln": ("CXXNET_PALLAS_LN", "0", ("1", "0")),
+    "pallas_ln": ("CXXNET_PALLAS_LN", "1", ("1", "x", "0")),
+    "fused_update": ("CXXNET_FUSED_UPDATE", "0", ("1", "0")),
 }
 
 

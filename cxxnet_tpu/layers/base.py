@@ -183,7 +183,16 @@ class LayerParam:
     def rand_init_weight(self, key: jax.Array, shape: Sequence[int],
                          in_num: int, out_num: int,
                          dtype=jnp.float32) -> jnp.ndarray:
-        """Weight init parity with ``param.h RandInitWeight`` (:113-138)."""
+        """Weight init following ``param.h RandInitWeight`` (:113-138).
+
+        Parity holds for random_type 0 (gaussian) and 1 (xavier/uniform)
+        only.  random_type 2 (kaiming) DELIBERATELY diverges from the
+        reference: ``param.h`` scales by the fan-OUT-ish
+        ``num_hidden/num_channel``, which under-scales exactly the deep
+        relu stacks kaiming exists for (see the round-5 GoogLeNet
+        vanishing-signal diagnosis below); we use the correct
+        ``sqrt(2 / fan_in)`` (He et al., 2015) instead.
+        """
         shape = tuple(shape)
         if self.random_type == 0:
             return self.init_sigma * jax.random.normal(key, shape, dtype)

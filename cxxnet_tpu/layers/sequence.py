@@ -151,14 +151,22 @@ class LayerNormLayer(Layer):
         rows = n * c * s
         from ..engine import opts
         from ..ops import pallas_kernels as pk
-        if (pk._on_tpu() and opts.pallas_ln == "1"  # opt-in: costs HBM (saved x)
+        if (pk._on_tpu() and opts.pallas_ln in ("1", "x")  # default-on (r6)
                 and pk.layernorm_pallas_supported(rows, d)):
             # single-sweep Pallas kernel: the XLA lowering left
             # ~1.9 ms/site convert_reduce fusions in the d2048 step
             # (47.9 ms over 25 sites vs 0.094 ms standalone — the fusion
-            # chains behind an operand copy); see pallas_kernels.py
+            # chains behind an operand copy).  Default-on since the
+            # backward went output-derived: residuals are (y, gamma,
+            # beta, rstd) with y aliasing the output, so the kernel no
+            # longer pins a per-site (rows, d) input copy (the round-5
+            # HBM trade that OOM'd the d2048 flagship).  pallas_ln = x
+            # keeps the kernel but saves the input (precision escape
+            # hatch for |beta| >> |gamma| bf16 configs); pallas_ln = 0
+            # restores the XLA lowering.  See doc/pallas_ln.md.
             y = pk.layernorm_pallas(x.reshape(rows, d), params["wmat"],
-                                    params["bias"], self.eps)
+                                    params["bias"], self.eps, None,
+                                    opts.pallas_ln == "x")
             return [y.reshape(x.shape)], buffers
         x32 = x.astype(jnp.float32)
         mean = x32.mean(axis=-1, keepdims=True)

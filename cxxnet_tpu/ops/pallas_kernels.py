@@ -409,18 +409,41 @@ def _pick_cb(c: int, per_cb_bytes: int, cap: int) -> int:
                  if cb * per_cb_bytes <= cap), legal[0])
 
 
+def _mp_mr_plan(c: int, w: int, nb: int, s: int, hb: int = None):
+    """Tile plan for the MULTI-ROW pool backward, shared by the shape gate
+    (:func:`max_pool_hwcn_supported`) and the kernel launcher
+    (:func:`_mp_hwcn_bwd`) so the two can't silently diverge: returns
+    ``(hb, cb, per_cb_bytes)``.
+
+    * ``hb`` — input rows per program; default 3*s (amortizes per-program
+      overhead), rounded down to a multiple of s (static candidate-row
+      offsets require s | hb).
+    * ``per_cb_bytes`` — dominant VMEM per (w, cb, nb) plane and row:
+      in/out blocks + the f32 row accumulators and their stack come to
+      ~12 block-planes per row.
+    * ``cb`` — largest legal channel tile fitting ``_MR_BWD_VMEM_CAP``
+      (via :func:`_pick_cb`); callers must still check
+      ``cb * per_cb_bytes <= _MR_BWD_VMEM_CAP`` — when no tile fits,
+      _pick_cb falls back to the smallest legal one, which over-allocates
+      and crashes Mosaic.
+    """
+    if hb is None:
+        hb = 3 * s
+    hb = max(hb - hb % s, s)
+    per = w * nb * 12 * hb
+    return hb, _pick_cb(c, per, _MR_BWD_VMEM_CAP), per
+
+
 def max_pool_hwcn_supported(shape, s: int) -> bool:
     """Shapes the hwcn pool kernel compiles for on TPU: the lane dim must
-    be full tiles for the bitcast boundary, and the tile _pick_cb chooses
-    for the multi-row backward (hb = 3*s rows) must actually fit its
-    budget — when none does, the fallback over-allocates and Mosaic
-    crashes (measured: c64/w224 k2s2 fails, c32/w147 and c64/w112
-    compile)."""
+    be full tiles for the bitcast boundary, and the tile the shared plan
+    picks for the multi-row backward must actually fit its budget
+    (measured: c64/w224 k2s2 fails, c32/w147 and c64/w112 compile)."""
     n, c, h, w = shape
     if n % 128 != 0:
         return False
-    per = w * 128 * 12 * (3 * s)
-    return _pick_cb(c, per, _MR_BWD_VMEM_CAP) * per <= _MR_BWD_VMEM_CAP
+    _, cb, per = _mp_mr_plan(c, w, 128, s)
+    return cb * per <= _MR_BWD_VMEM_CAP
 
 
 # --------------------------------------------------------------------------
@@ -588,19 +611,14 @@ def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
     ncand = -(-k // s)
     nb = 128 if n % 128 == 0 else n
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
-    if hb is None:
-        hb = 3 * s  # multi-row default: amortizes per-program overhead
-    if hb > 1:
-        # multi-row blocks need s | hb (static candidate offsets)
-        hb = max(hb - hb % s, s)
+    if hb is None or hb > 1:
+        # tile plan shared with max_pool_hwcn_supported (_mp_mr_plan).
+        # Under _MR_BWD_VMEM_CAP every proven AlexNet shape picks the same
+        # tile as the original 14 MB halving loop did
+        hb, cb, _ = _mp_mr_plan(c, w, nb, s, hb)
         rel0 = (-(k - 1) + (s - 1)) // s
         rel_last = (hb - 1 - (k - 1) + (s - 1)) // s - rel0
         nref = rel_last + ncand
-        # dominant VMEM per (w, cb, nb) plane: in/out blocks + the f32
-        # row accumulators and their stack (~12 block-planes per row).
-        # Under _MR_BWD_VMEM_CAP every proven AlexNet shape picks the same
-        # tile as the original 14 MB halving loop did
-        cb = _pick_cb(c, w * nb * 12 * hb, _MR_BWD_VMEM_CAP)
 
         def p_imap(i):
             def imap(bc, bn, bh):
@@ -1305,8 +1323,39 @@ flash_attention.defvjp(_flash_fwd_res, _flash_bwd_res)
 # fusions in the step (25 sites, 47.9 ms/step) for an op whose standalone
 # cost is 0.094 ms — the fusion stalls on an operand copy the scheduler
 # chains it behind.  A custom-vjp kernel pins both passes to single
-# VMEM-resident sweeps; backward uses the saved f32 mean/rstd and
-# accumulates dgamma/dbeta across row-blocks in scratch (grid dim 0 is
+# VMEM-resident sweeps.
+#
+# Residual contract (round 6, "stats-only"): the round-5 kernel saved the
+# INPUT x as a residual, pinning a (rows, d) buffer per site (~64 MB x 25
+# sites at the d2048 flagship) that XLA's auto-remat had been recomputing
+# from the cheap residual-stream adds — enabling pallas_ln then OOM'd the
+# flagship by 0.8 GB.  The backward is now formulated from the OUTPUT:
+#
+#     xhat = (y - beta) / gamma
+#     dx   = rstd * (dy*gamma - mean_d(dy*gamma) - xhat * mean_d(dy*gamma*xhat))
+#     dgamma = sum_rows(dy * xhat);  dbeta = sum_rows(dy)
+#
+# so the residuals are (y, gamma, beta, rstd): y is the op's own primal
+# output (the SAME value, not a copy — under jit the residual aliases the
+# output buffer, which the downstream matmul wgrad keeps live anyway), and
+# everything else is O(rows) f32 stats or (d,) vectors.  No (rows, d)
+# buffer beyond the output exists in the vjp pytree, and the input x is
+# free to be rematerialized — this is the FlashAttention idiom (keep
+# O(rows) softmax/normalization stats, rebuild the O(rows*d) intermediate
+# inside the backward kernel) applied to LN.
+#
+# Caveats of the rebuild (see doc/pallas_ln.md):
+# * columns where gamma is EXACTLY zero lose xhat — the kernel
+#   substitutes xhat=0 there (a stop-gradient of the normalized value,
+#   not an inf).  gamma init is 1.0; training leaves exact zeros
+#   measure-zero.
+# * precision: xhat carries the STORED-dtype rounding of y amplified by
+#   the y-beta cancellation — abs error ~ eps_dtype*(|y|+|beta|)/|gamma|.
+#   For beta ~ 0 this reduces to eps_dtype*|xhat| (benign, gamma
+#   cancels); it bites in bf16 when |beta| >> |gamma|.  ``save_x=True``
+#   (config ``pallas_ln = x``) restores the round-5 input-saving
+#   residuals for precision-critical configs, re-accepting the HBM pin.
+# dgamma/dbeta accumulate across row-blocks in scratch (grid dim 0 is
 # sequential, so the accumulation is legal, as in conv_wgrad's pattern).
 
 
@@ -1324,8 +1373,41 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, m_ref, r_ref, *, eps):
     r_ref[...] = rstd
 
 
-def _ln_bwd_kernel(x_ref, g_ref, m_ref, r_ref, dy_ref, dx_ref, dg_ref,
+def _ln_bwd_kernel(y_ref, g_ref, b_ref, r_ref, dy_ref, dx_ref, dg_ref,
                    db_ref, dg_acc, db_acc):
+    i = pl.program_id(0)
+    y = y_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    b = b_ref[...].astype(jnp.float32)
+    rstd = r_ref[...]
+    # rebuild xhat from the output (see residual contract above); columns
+    # with gamma exactly 0 carry no xhat information — substitute 0
+    zero_g = g == 0.0
+    xhat = jnp.where(zero_g, 0.0, (y - b) / jnp.where(zero_g, 1.0, g))
+    dyg = dy * g
+    c1 = dyg.mean(axis=1, keepdims=True)
+    c2 = (dyg * xhat).mean(axis=1, keepdims=True)
+    dx_ref[...] = (rstd * (dyg - c1 - xhat * c2)).astype(dx_ref.dtype)
+
+    @pl.when(i == 0)
+    def _():
+        dg_acc[...] = jnp.zeros_like(dg_acc)
+        db_acc[...] = jnp.zeros_like(db_acc)
+    dg_acc[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_acc[...] += jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        dg_ref[...] = dg_acc[...]
+        db_ref[...] = db_acc[...]
+
+
+def _ln_bwd_kernel_x(x_ref, g_ref, m_ref, r_ref, dy_ref, dx_ref, dg_ref,
+                     db_ref, dg_acc, db_acc):
+    """save_x backward (the round-5 form): xhat from the saved INPUT and
+    stats — no gamma division, so no cancellation amplification; costs
+    the pinned (rows, d) input residual."""
     i = pl.program_id(0)
     x = x_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
@@ -1350,6 +1432,13 @@ def _ln_bwd_kernel(x_ref, g_ref, m_ref, r_ref, dy_ref, dx_ref, dg_ref,
         db_ref[...] = db_acc[...]
 
 
+def _ln_specs(rows, d, rb):
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    return (pl.BlockSpec((rb, d), lambda i: (i, 0), **kw),
+            pl.BlockSpec((1, d), lambda i: (0, 0), **kw),
+            pl.BlockSpec((rb, 1), lambda i: (i, 0), **kw))
+
+
 def _ln_rows(rows: int, d: int) -> int:
     """Largest row block dividing rows whose ~6 f32 block-sized
     temporaries (x, xhat, dy, dyg + outputs) fit the VMEM budget."""
@@ -1366,15 +1455,24 @@ def layernorm_pallas_supported(rows: int, d: int) -> bool:
             and d * rb * 4 * 6 <= (8 << 20))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def layernorm_pallas(x, gamma, beta, eps: float = 1e-5,
-                     interpret: bool = None):
-    """(rows, d) layernorm over axis 1; gamma/beta (d,)."""
-    y, _ = _ln_fwd_res(x, gamma, beta, eps, interpret)
+                     interpret: bool = None, save_x: bool = False):
+    """(rows, d) layernorm over axis 1; gamma/beta (d,).
+
+    The default backward is output-derived (stats-only residuals — see
+    the section comment): the vjp saves only (y, gamma, beta, rstd),
+    where y aliases the primal output, so enabling this kernel adds no
+    (rows, d) activation memory over the XLA lowering.  ``save_x=True``
+    (config ``pallas_ln = x``) restores the round-5 input-saving
+    residuals — the precision escape hatch for bf16 configs with
+    |beta| >> |gamma| columns — and re-accepts the pinned x.
+    """
+    y, _ = _ln_fwd_res(x, gamma, beta, eps, interpret, save_x)
     return y
 
 
-def _ln_fwd_res(x, gamma, beta, eps, interpret):
+def _ln_fwd_res(x, gamma, beta, eps, interpret, save_x=False):
     if interpret is None:
         interpret = not _on_tpu()
     rows, d = x.shape
@@ -1383,10 +1481,7 @@ def _ln_fwd_res(x, gamma, beta, eps, interpret):
         f"layernorm_pallas: rows={rows} not divisible by row block {rb} "
         "(tail rows would be silently uninitialized); gate with "
         "layernorm_pallas_supported()")
-    kw = {} if _VMEM is None else {"memory_space": _VMEM}
-    row_spec = pl.BlockSpec((rb, d), lambda i: (i, 0), **kw)
-    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0), **kw)
-    stat_spec = pl.BlockSpec((rb, 1), lambda i: (i, 0), **kw)
+    row_spec, vec_spec, stat_spec = _ln_specs(rows, d, rb)
     y, mean, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(rows // rb,),
@@ -1397,33 +1492,130 @@ def _ln_fwd_res(x, gamma, beta, eps, interpret):
                    jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
         interpret=interpret,
     )(x, gamma.reshape(1, d), beta.reshape(1, d))
-    return y, (x, gamma, mean, rstd)
+    if save_x:
+        return y, (x, gamma, mean, rstd)
+    # y in the residuals IS the primal output (same value — the buffer is
+    # shared under jit); the input x is deliberately NOT saved
+    return y, (y, gamma, beta, rstd)
 
 
-def _ln_bwd_res(eps, interpret, res, dy):
-    x, gamma, mean, rstd = res
+def _ln_bwd_res(eps, interpret, save_x, res, dy):
     if interpret is None:
         interpret = not _on_tpu()
-    rows, d = x.shape
+    rows, d = res[0].shape
     rb = _ln_rows(rows, d)
     assert rows % rb == 0, "layernorm_pallas: unsupported row count"
-    kw = {} if _VMEM is None else {"memory_space": _VMEM}
-    row_spec = pl.BlockSpec((rb, d), lambda i: (i, 0), **kw)
-    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0), **kw)
-    stat_spec = pl.BlockSpec((rb, 1), lambda i: (i, 0), **kw)
+    row_spec, vec_spec, stat_spec = _ln_specs(rows, d, rb)
+    if save_x:
+        x, gamma, mean, rstd = res
+        kern = _ln_bwd_kernel_x
+        args = (x, gamma.reshape(1, d), mean, rstd, dy)
+        in_specs = [row_spec, vec_spec, stat_spec, stat_spec, row_spec]
+    else:
+        y, gamma, beta, rstd = res
+        kern = _ln_bwd_kernel
+        args = (y, gamma.reshape(1, d), beta.reshape(1, d), rstd, dy)
+        in_specs = [row_spec, vec_spec, vec_spec, stat_spec, row_spec]
     dx, dg, db = pl.pallas_call(
-        _ln_bwd_kernel,
+        kern,
         grid=(rows // rb,),
-        in_specs=[row_spec, vec_spec, stat_spec, stat_spec, row_spec],
+        in_specs=in_specs,
         out_specs=[row_spec, vec_spec, vec_spec],
-        out_shape=[jax.ShapeDtypeStruct((rows, d), x.dtype),
+        out_shape=[jax.ShapeDtypeStruct((rows, d), res[0].dtype),
                    jax.ShapeDtypeStruct((1, d), jnp.float32),
                    jax.ShapeDtypeStruct((1, d), jnp.float32)],
         scratch_shapes=_scratch((1, d), (1, d)),
         interpret=interpret,
-    )(x, gamma.reshape(1, d), mean, rstd, dy)
+    )(*args)
     return dx, dg.reshape(d).astype(gamma.dtype), \
         db.reshape(d).astype(gamma.dtype)
 
 
 layernorm_pallas.defvjp(_ln_fwd_res, _ln_bwd_res)
+
+
+# --------------------------------------------------------------------------
+# Fused master-weight adam update.  The round-5 transformer per-op table
+# charges ~47.5 ms/step to convert_reduce fusions: XLA materializes the
+# f32 cast of each bf16 weight-grad to HBM before the adam fusion reads
+# it, and writes the bf16 cast of the updated master back in a separate
+# pass — two extra full-tensor HBM round trips per parameter.  This
+# kernel folds the whole update chain (bf16 grad read -> clip -> wd ->
+# moments -> master write -> bf16 param write) into ONE VMEM sweep: every
+# convert happens in-register, so per parameter the HBM traffic is the
+# irreducible read(g, m1, m2, w32) + write(m1, m2, w32, p).
+#
+# Scope: adam + f32-master (bf16 params) tensors whose size tiles as
+# (8k rows, 1024 lanes) — the transformer's big matrices; small/odd
+# tensors (gamma/beta vectors, biases) keep the XLA path, where they cost
+# nothing.  Opt-in via the `fused_update` engine option until a TPU
+# session A/Bs it (the candidate win is the convert_reduce line; the
+# adam math itself XLA already fuses well).
+
+
+_FU_LANES = 1024
+
+
+def fused_adam_supported(p) -> bool:
+    """Tensors the fused update kernel takes: bf16 working params (else
+    there is no master and no convert to fuse) tiling as (8k, 1024)."""
+    return (pltpu is not None and p.dtype == jnp.bfloat16
+            and p.size % (8 * _FU_LANES) == 0)
+
+
+def _fused_adam_kernel(lr_ref, g_ref, m1_ref, m2_ref, w_ref,
+                       p_out, m1_out, m2_out, w_out, *, d1, d2, wd, clip,
+                       eps):
+    g = g_ref[...].astype(jnp.float32)
+    if clip:
+        # NaN-zeroing clip (sgd_updater-inl.hpp:15-22), as hyper.clip
+        g = jnp.clip(jnp.where(jnp.isnan(g), 0.0, g), -clip, clip)
+    w = w_ref[...]
+    if wd > 0.0:  # same gate as AdamUpdater._apply32 (wd <= 0 is a no-op)
+        g = g - wd * w  # reference adam's sign (adam_updater-inl.hpp:76)
+    m1 = m1_ref[...] + d1 * (g - m1_ref[...])
+    m2 = m2_ref[...] + d2 * (jnp.square(g) - m2_ref[...])
+    w = w - lr_ref[0, 0] * (m1 / (jnp.sqrt(m2) + eps))
+    m1_out[...] = m1
+    m2_out[...] = m2
+    w_out[...] = w
+    p_out[...] = w.astype(p_out.dtype)
+
+
+def fused_adam_pallas(g, m1, m2, w32, lr_t, *, d1, d2, wd=0.0, clip=0.0,
+                      out_dtype=jnp.bfloat16, interpret=None):
+    """One-sweep adam step on a flattened tensor: returns
+    ``(p_new, m1_new, m2_new, w32_new)`` with ``p_new`` in ``out_dtype``.
+
+    ``lr_t`` is the fully bias-corrected step size (a traced f32 scalar,
+    fed through SMEM); ``d1``/``d2`` are the reference's DECAY rates.
+    Gate with :func:`fused_adam_supported`.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    n = w32.size
+    r = n // _FU_LANES
+    rb = 128
+    while rb > 8 and r % rb:
+        rb //= 2
+    assert r % rb == 0, "fused_adam_pallas: gate with fused_adam_supported"
+    sh = (r, _FU_LANES)
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    row = pl.BlockSpec((rb, _FU_LANES), lambda i: (i, 0), **kw)
+    lr_spec = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                           memory_space=pltpu.SMEM)
+    kern = functools.partial(_fused_adam_kernel, d1=d1, d2=d2, wd=wd,
+                             clip=clip, eps=1e-8)
+    p_new, m1n, m2n, wn = pl.pallas_call(
+        kern,
+        grid=(r // rb,),
+        in_specs=[lr_spec, row, row, row, row],
+        out_specs=[row, row, row, row],
+        out_shape=[jax.ShapeDtypeStruct(sh, out_dtype)]
+        + [jax.ShapeDtypeStruct(sh, jnp.float32)] * 3,
+        interpret=interpret,
+    )(jnp.asarray(lr_t, jnp.float32).reshape(1, 1), g.reshape(sh),
+      m1.reshape(sh), m2.reshape(sh), w32.reshape(sh))
+    shape = w32.shape
+    return (p_new.reshape(shape), m1n.reshape(shape),
+            m2n.reshape(shape), wn.reshape(shape))

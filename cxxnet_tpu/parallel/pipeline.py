@@ -35,9 +35,11 @@ except ImportError:  # pragma: no cover
 
 def shard_map(f, **kw):
     """Version shim: the replication-check kwarg was renamed
-    check_rep -> check_vma when shard_map left jax.experimental."""
-    if "check_rep" in kw and _REP_KW != "check_rep":
-        kw[_REP_KW] = kw.pop("check_rep")
+    check_rep -> check_vma when shard_map left jax.experimental.
+    Accepts either spelling and forwards whichever this jax takes."""
+    for alias in ("check_rep", "check_vma"):
+        if alias in kw and _REP_KW != alias:
+            kw[_REP_KW] = kw.pop(alias)
     return _shard_map(f, **kw)
 
 

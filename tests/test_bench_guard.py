@@ -1,0 +1,68 @@
+"""Bench regression guard (VERDICT r5 #4 / round-6 item 4).
+
+``bench.py`` records ``device_step_ms`` (on-chip time from a trace — the
+session-comparable number) in each round's ``BENCH_r*.json``; BASELINE.md
+records the accepted number.  Nothing previously GATED on the two
+agreeing, so a lowering change that silently regressed device time would
+only surface when a human re-read the tables.  This module compares the
+newest bench record against the baseline with a ±10% budget.
+
+Marked ``slow``: it is excluded from the tier-1 CPU suite (the JSONs are
+produced on TPU sessions; a CPU checkout may carry stale ones) and meant
+to run right after a bench session:
+
+    python -m pytest tests/test_bench_guard.py -m slow
+
+The semantic twin of this guard — the pairtest tolerance envelope — lives
+in ``tests/test_pairtest_gate.py``.
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUDGET = 0.10  # fractional regression allowed before the guard trips
+
+
+def _newest_bench():
+    recs = sorted(REPO.glob("BENCH_r*.json"))
+    if not recs:
+        pytest.skip("no BENCH_r*.json records in the repo")
+    return recs[-1]
+
+
+def _baseline_device_ms():
+    """The accepted AlexNet device step from BASELINE.md: last table row
+    naming it, last ms figure in the row (columns are oldest->newest)."""
+    text = (REPO / "BASELINE.md").read_text()
+    rows = [ln for ln in text.splitlines()
+            if "AlexNet" in ln and "device step" in ln]
+    if not rows:
+        pytest.skip("BASELINE.md has no 'AlexNet ... device step' row")
+    ms = re.findall(r"([0-9]+(?:\.[0-9]+)?)\s*ms", rows[-1])
+    if not ms:
+        pytest.skip("could not parse a ms figure from the baseline row")
+    return float(ms[-1])
+
+
+@pytest.mark.slow
+def test_device_step_within_budget():
+    rec = json.loads(_newest_bench().read_text())
+    parsed = rec.get("parsed") or {}
+    dev = parsed.get("device_step_ms")
+    if dev is None:
+        pytest.skip(f"{_newest_bench().name} has no device_step_ms "
+                    "(trace failed that session)")
+    base = _baseline_device_ms()
+    assert dev <= base * (1.0 + BUDGET), (
+        f"device_step_ms regressed: {dev:.2f} ms vs baseline {base:.2f} ms "
+        f"(+{(dev / base - 1) * 100:.1f}%, budget +{BUDGET * 100:.0f}%) — "
+        "either find the regression or re-baseline BASELINE.md with the "
+        "explanation")
+    # a big IMPROVEMENT is also a finding: it means BASELINE.md is stale
+    if dev < base * (1.0 - BUDGET):
+        pytest.skip(f"device_step_ms improved past the budget "
+                    f"({dev:.2f} vs {base:.2f} ms) — update BASELINE.md")

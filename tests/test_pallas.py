@@ -380,6 +380,238 @@ def test_pool_channel_tile_legality():
     assert not max_pool_hwcn_supported((100, 64, 28, 28), 2)  # lanes
 
 
+def _ln_rel_err(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    denom = max(np.abs(b).max(), 1e-30)
+    return float(np.abs(a - b).max() / denom)
+
+
+def _ln_ref(x, g, b, eps=1e-5):
+    """The layer's XLA fallback formulation (two-pass f32 moments)."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = jnp.square(x32 - mean).mean(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * g.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def test_layernorm_pallas_residuals_stats_only():
+    """The custom-vjp residual pytree holds NO (rows, d) buffer beyond the
+    op's own output: the only (rows, d) leaf IS the primal output (same
+    array — under jit the buffer aliases), the input x is absent, and the
+    remaining leaves are O(rows) stats / (d,) vectors.  This is the
+    round-6 un-pinning contract (the round-5 kernel saved x, pinning
+    ~64 MB x 25 sites on the d2048 flagship)."""
+    from cxxnet_tpu.ops.pallas_kernels import _ln_fwd_res, layernorm_pallas
+    rnd = np.random.RandomState(0)
+    rows, d = 512, 256
+    x = jnp.asarray(rnd.randn(rows, d).astype(np.float32))
+    g = jnp.asarray(rnd.rand(d).astype(np.float32) + 0.5)
+    b = jnp.asarray(rnd.randn(d).astype(np.float32))
+    y, res = _ln_fwd_res(x, g, b, 1e-5, True)
+    leaves = jax.tree_util.tree_leaves(res)
+    big = [l for l in leaves if l.size >= rows * d]
+    assert big and all(l is y for l in big), (
+        "residuals must not contain any (rows, d) array besides the "
+        "aliased primal output")
+    assert not any(l.shape == x.shape and np.allclose(l, x)
+                   for l in leaves if l is not y), "input x was saved"
+    # every other leaf is O(rows) or O(d)
+    assert all(l.size <= max(rows, d) for l in leaves if l is not y)
+    # and the vjp closure (what jax actually keeps live for backward)
+    # carries exactly ONE distinct (rows, d) buffer — the output
+    yv, vjp = jax.vjp(lambda *a: layernorm_pallas(*a, 1e-5, True), x, g, b)
+    closure_big = [l for l in jax.tree_util.tree_leaves(vjp)
+                   if hasattr(l, "size") and l.size >= rows * d]
+    ptrs = {l.unsafe_buffer_pointer() for l in closure_big}
+    assert len(ptrs) == 1
+    assert yv.unsafe_buffer_pointer() in ptrs
+
+
+@pytest.mark.parametrize("rows,d,dtype,tol", [
+    (16384, 2048, jnp.float32, 1e-5),   # flagship-shaped (d2048 L12 s4096)
+    (16384, 2048, jnp.bfloat16, 1e-1),
+    (384, 640, jnp.float32, 1e-5),      # non-square, odd row-block shape
+    (384, 640, jnp.bfloat16, 1e-1),
+])
+def test_layernorm_pallas_bwd_parity(rows, d, dtype, tol):
+    """Output-derived backward == the jnp reference LN for dx, dgamma,
+    dbeta (max rel-err: f32 <= 1e-5, bf16 <= 1e-1 — the documented
+    pairtest envelope), at the flagship shape and a non-square one."""
+    from cxxnet_tpu.ops.pallas_kernels import (layernorm_pallas,
+                                               layernorm_pallas_supported)
+    assert layernorm_pallas_supported(rows, d)
+    rnd = np.random.RandomState(42)
+    x = jnp.asarray(rnd.randn(rows, d).astype(np.float32)).astype(dtype)
+    g = jnp.asarray((rnd.rand(d).astype(np.float32) + 0.5)).astype(dtype)
+    b = jnp.asarray((rnd.randn(d).astype(np.float32) * 0.5)).astype(dtype)
+    dy = jnp.asarray(rnd.randn(rows, d).astype(np.float32)).astype(dtype)
+    y1, vjp1 = jax.vjp(lambda *a: layernorm_pallas(*a, 1e-5, True), x, g, b)
+    y2, vjp2 = jax.vjp(_ln_ref, x, g, b)
+    assert _ln_rel_err(y1, y2) <= tol
+    g1, g2 = vjp1(dy), vjp2(dy)
+    for a, bb, nm in zip(g1, g2, ("dx", "dgamma", "dbeta")):
+        err = _ln_rel_err(a, bb)
+        assert err <= tol, f"{nm}: rel err {err:.3e} > {tol}"
+
+
+def test_layernorm_pallas_save_x_small_gamma():
+    """The output-derived rebuild amplifies stored-dtype rounding by
+    ~(|y|+|beta|)/|gamma| (cancellation in y - beta), so bf16 columns
+    with |beta| >> |gamma| can exceed the 1e-1 envelope.  The save_x
+    escape hatch (pallas_ln = x) must stay tight there: it reads the
+    saved input, no gamma division."""
+    from cxxnet_tpu.ops.pallas_kernels import _ln_fwd_res, layernorm_pallas
+    rnd = np.random.RandomState(11)
+    rows, d = 256, 256
+    x = jnp.asarray(rnd.randn(rows, d).astype(np.float32)).astype(
+        jnp.bfloat16)
+    g = jnp.full((d,), 0.01, jnp.bfloat16)       # small-but-nonzero gamma
+    b = jnp.asarray(rnd.randn(d).astype(np.float32)).astype(jnp.bfloat16)
+    dy = jnp.asarray(rnd.randn(rows, d).astype(np.float32)).astype(
+        jnp.bfloat16)
+    g1 = jax.vjp(lambda *a: layernorm_pallas(*a, 1e-5, True, True),
+                 x, g, b)[1](dy)
+    g2 = jax.vjp(_ln_ref, x, g, b)[1](dy)
+    for a, bb, nm in zip(g1, g2, ("dx", "dgamma", "dbeta")):
+        err = _ln_rel_err(a, bb)
+        assert err <= 1e-1, f"save_x {nm}: rel err {err:.3e}"
+    # and save_x residuals are the round-5 set: x IS saved
+    _, res = _ln_fwd_res(x, g, b, 1e-5, True, True)
+    assert any(l.shape == x.shape and np.array_equal(
+        np.asarray(l, np.float32), np.asarray(x, np.float32))
+        for l in jax.tree_util.tree_leaves(res))
+
+
+def test_layernorm_pallas_zero_gamma_guard():
+    """Columns where gamma is EXACTLY zero can't rebuild xhat from the
+    output; the kernel substitutes xhat=0 there.  The backward must stay
+    finite, dbeta stays exact, and the zeroed column's dgamma is 0."""
+    from cxxnet_tpu.ops.pallas_kernels import layernorm_pallas
+    rnd = np.random.RandomState(3)
+    rows, d = 64, 256
+    x = jnp.asarray(rnd.randn(rows, d).astype(np.float32))
+    g = jnp.asarray(rnd.rand(d).astype(np.float32) + 0.5).at[7].set(0.0)
+    b = jnp.asarray(rnd.randn(d).astype(np.float32))
+    dy = jnp.asarray(rnd.randn(rows, d).astype(np.float32))
+    _, vjp = jax.vjp(lambda *a: layernorm_pallas(*a, 1e-5, True), x, g, b)
+    dx, dg, db = vjp(dy)
+    assert np.isfinite(np.asarray(dx)).all()
+    assert float(dg[7]) == 0.0
+    np.testing.assert_allclose(np.asarray(db), np.asarray(dy.sum(0)),
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_layernorm_default_on_and_layer_route(monkeypatch):
+    """pallas_ln defaults ON; on (emulated) TPU the layernorm layer routes
+    through layernorm_pallas wherever layernorm_pallas_supported holds."""
+    import cxxnet_tpu.engine as engine
+    from cxxnet_tpu.layers.base import ForwardContext
+    from cxxnet_tpu.layers.sequence import LayerNormLayer
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    # the fresh-default assert must not read a CXXNET_PALLAS_LN the shell
+    # exported for an A/B session (doc/pallas_ln.md recipe)
+    monkeypatch.delenv("CXXNET_PALLAS_LN", raising=False)
+    assert engine._Options().pallas_ln == "1"  # fresh default (no env)
+    monkeypatch.setattr(engine.opts, "pallas_ln", "1")
+    monkeypatch.setattr(pk, "_on_tpu", lambda: True)
+    calls = []
+    real = pk.layernorm_pallas
+
+    def spy(x, g, b, eps, interpret=None, save_x=False):
+        calls.append(x.shape)
+        return real(x, g, b, eps, True, save_x)  # interpret: still on CPU
+    monkeypatch.setattr(pk, "layernorm_pallas", spy)
+    layer = LayerNormLayer()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 1, 8, 128),
+                    jnp.float32)
+    params = layer.init_params(jax.random.PRNGKey(0), [x.shape])
+    (y,), _ = layer.forward(params, {}, [x], ForwardContext(train=True))
+    assert calls == [(16, 128)]
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_ln_ref(x, params["wmat"],
+                                          params["bias"])).reshape(x.shape),
+        rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("wd,clip,epoch", [(0.0, 0.0, 0), (0.001, 0.5, 7)])
+def test_fused_adam_matches_reference(wd, clip, epoch):
+    """fused_adam_pallas == AdamUpdater's XLA path (param, moments, and
+    master) for bf16-master tensors, including clip/wd and bias
+    correction, over multiple chained steps."""
+    from cxxnet_tpu.engine import opts
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    from cxxnet_tpu.updater.updaters import AdamUpdater, UpdaterHyper
+    rnd = np.random.RandomState(1)
+    p = jnp.asarray(rnd.randn(16, 1024) * 0.1).astype(jnp.bfloat16)
+    u = AdamUpdater()
+    hyper = UpdaterHyper(tag="wmat", base_lr=0.01, wd=wd,
+                         clip_gradient=clip)
+    assert pk.fused_adam_supported(p)
+    assert not pk.fused_adam_supported(p.astype(jnp.float32))  # no master
+    assert not pk.fused_adam_supported(  # odd size
+        jnp.zeros((3, 1000), jnp.bfloat16))
+    s_ref = u.make_state(p)
+    s_fu = jax.tree.map(lambda a: a, s_ref)
+    p_ref = p_fu = p
+    for step in range(3):
+        g = jnp.asarray(rnd.randn(16, 1024) * 0.01).astype(jnp.bfloat16)
+        if step == 1 and clip:
+            g = g.at[0, 0].set(jnp.nan).at[0, 1].set(5.0)  # clip paths
+        p_ref, s_ref = u.apply(p_ref, g, s_ref, hyper, epoch + step)
+        saved = opts.fused_update
+        try:
+            opts.set("fused_update", "1")
+            p_fu, s_fu = u.apply(p_fu, g, s_fu, hyper, epoch + step)
+        finally:
+            opts.set("fused_update", saved)
+        # tolerances: the two lowerings contract multiply-adds
+        # differently (FMA), so states differ by a couple of f32 ULPs;
+        # params by at most one bf16 rounding step
+        np.testing.assert_allclose(np.asarray(p_fu, np.float32),
+                                   np.asarray(p_ref, np.float32),
+                                   atol=4e-3, rtol=0)
+        for k in ("m1", "m2", "w32"):
+            np.testing.assert_allclose(
+                np.asarray(s_fu[k]), np.asarray(s_ref[k]),
+                rtol=1e-5, atol=1e-7, err_msg=f"{k} step {step}")
+
+
+def test_flash_attention_multiblock_causal_grads():
+    """jax.grad parity vs dense_attention through the TRIANGULAR causal
+    grids with several blocks per row/column: asymmetric (256, 512)
+    blocks and a square bq==bk (256, 256) case.  Exercises the
+    _fa_dq_kernel_tri jlast and _fa_dkv_kernel_tri ifirst boundaries
+    past one block (ADVICE r5 medium: they were previously never run
+    with nq, nk > 1)."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    from cxxnet_tpu.parallel.ring import dense_attention
+    rnd = np.random.RandomState(5)
+    q, k, v = (jnp.asarray(rnd.randn(1, 2, 1024, 32).astype(np.float32)
+                           * 0.5) for _ in range(3))
+    gr = jax.grad(lambda *a: jnp.sum(
+        dense_attention(*a, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    old_blocks = pk._fa_blocks
+    try:
+        for blocks in ((256, 512), (256, 256)):
+            pk._fa_blocks = lambda s, d=64, b=blocks: b
+            out = pk.flash_attention(q, k, v, True)
+            ref = dense_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-5, err_msg=str(blocks))
+            gf = jax.grad(lambda *a: jnp.sum(
+                pk.flash_attention(*a, True) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            for a, b, nm in zip(gf, gr, ("dq", "dk", "dv")):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=2e-4,
+                    err_msg=f"{nm} blocks={blocks}")
+    finally:
+        pk._fa_blocks = old_blocks
+
+
 def test_layernorm_pallas_matches_xla():
     """layernorm_pallas fwd + all three grads == the XLA formulation
     (sequence.LayerNormLayer's fallback path)."""
