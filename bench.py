@@ -43,6 +43,32 @@ def baseline_json(imgs_per_sec: float, extra: dict = None) -> dict:
     return out
 
 
+def metrics_sink_spec(argv=None) -> str:
+    """Sink spec for bench records: a ``metrics_sink=jsonl:<path>`` CLI
+    arg wins over the CXXNET_METRICS_SINK env var; empty disables."""
+    import os
+    spec = os.environ.get("CXXNET_METRICS_SINK", "")
+    for a in (sys.argv[1:] if argv is None else argv):
+        if a.startswith("metrics_sink="):
+            spec = a.split("=", 1)[1]
+    return spec
+
+
+def emit_bench_record(payload: dict, argv=None) -> None:
+    """Mirror the stdout JSON into the telemetry JSONL sink, so
+    BENCH_*.json numbers and monitor records share one field vocabulary
+    (device_step_ms, step_ms_median, transformer_device_step_ms, ...)
+    and one pandas/gnuplot pipeline reads both."""
+    spec = metrics_sink_spec(argv)
+    if not spec:
+        return
+    from cxxnet_tpu.monitor.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.configure_sink(spec)
+    reg.emit("bench", **payload)
+    reg.close()
+
+
 def conv_flops_per_image(net) -> float:
     """Forward MAC*2 count from the built graph's shapes."""
     from cxxnet_tpu.layers.conv import ConvolutionLayer
@@ -63,25 +89,11 @@ def conv_flops_per_image(net) -> float:
 
 
 def _trace_device_ms(tracedir: str) -> float:
-    """Total on-chip XLA-module time in a trace (all modules)."""
-    import glob
-    import os
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    paths = glob.glob(os.path.join(tracedir, "**", "*.xplane.pb"),
-                      recursive=True)
-    xs = xplane_pb2.XSpace()
-    with open(max(paths, key=os.path.getmtime), "rb") as f:
-        xs.ParseFromString(f.read())
-    tot = 0.0
-    for plane in xs.planes:
-        if "TPU" not in plane.name:
-            continue
-        for line in plane.lines:
-            if "XLA Modules" not in line.name:
-                continue
-            for ev in line.events:
-                tot += ev.duration_ps / 1e9
-    return tot
+    """Total on-chip XLA-module time in a trace (all modules) — the
+    shared parser in cxxnet_tpu/monitor/trace.py (tools/trace_summary.py
+    reads the same files for the per-op view)."""
+    from cxxnet_tpu.monitor.trace import device_total_ms
+    return device_total_ms(tracedir)
 
 
 def _traced_device_step_ms(t, datas, labels, scan_len, tdir) -> float:
@@ -416,7 +428,12 @@ def main() -> None:
               file=sys.stderr)
     except Exception as e:
         print(f"bench: VGG secondary metric failed: {e}", file=sys.stderr)
-    print(json.dumps(baseline_json(imgs_per_sec, spread)))
+    payload = baseline_json(imgs_per_sec, spread)
+    try:
+        emit_bench_record(payload)
+    except Exception as e:  # the sink must never break the headline
+        print(f"bench: metrics sink failed: {e}", file=sys.stderr)
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

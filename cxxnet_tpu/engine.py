@@ -126,6 +126,13 @@ class _Options:
 opts = _Options()
 
 
+def snapshot() -> dict:
+    """Current value of every engine option — the telemetry "run" record
+    and ``trainer.engine_opts_used`` both read through this, so audits
+    and JSONL sinks agree on spelling."""
+    return {k: getattr(opts, k) for k in _DEFS}
+
+
 def is_engine_option(name: str) -> bool:
     return name in _DEFS
 

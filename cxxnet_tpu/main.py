@@ -21,6 +21,8 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .io.factory import create_iterator, init_iterator
+from .monitor import log as mlog
+from .monitor.trace import ProfileWindow
 from .nnet.trainer import NetTrainer
 from .utils.config import parse_config_file, parse_keyval_args
 
@@ -49,6 +51,15 @@ class LearnTask:
         self.synth_device_data = 0
         self.extract_node_name = ""
         self.prof_dir = ""
+        # generalized profiling window (doc/monitor.md): start the trace
+        # before global update step prof_start_step and run prof_num_steps
+        # dispatches (0 = to round end).  The default -1 keeps the legacy
+        # window — the whole round past compilation
+        self.prof_start_step = -1
+        self.prof_num_steps = 0
+        # wall seconds of the first train dispatch (jit trace + compile
+        # happen synchronously inside it); None until it ran
+        self.compile_sec: Optional[float] = None
         self.test_on_server = 0
         self.name_pred = "pred.txt"
         self.output_format = 1
@@ -84,6 +95,7 @@ class LearnTask:
             self.max_round = int(val)
         elif name == "silent":
             self.silent = int(val)
+            mlog.set_silent(self.silent)
         elif name == "task":
             self.task = val
         elif name == "dev":
@@ -100,14 +112,18 @@ class LearnTask:
             self.eval_train = int(val)
         elif name == "prof":
             self.prof_dir = val
+        elif name == "prof_start_step":
+            self.prof_start_step = int(val)
+        elif name == "prof_num_steps":
+            self.prof_num_steps = int(val)
         elif name == "test_on_server":
             self.test_on_server = int(val)
         elif name == "output_format":
             # Reference (cxxnet_main.cpp:100-102) treats anything non-"txt"
             # as binary; keep that contract but warn on unknown spellings.
             if val not in ("txt", "bin"):
-                print(f"output_format={val!r} not 'txt'/'bin'; "
-                      "treating as binary", file=sys.stderr)
+                mlog.warn(f"output_format={val!r} not 'txt'/'bin'; "
+                          "treating as binary")
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
 
@@ -161,15 +177,15 @@ class LearnTask:
         if "dist_num_worker" not in cfg:
             self.set_param("dist_num_worker", str(nproc))
             self.set_param("dist_worker_rank", str(rank))
-        if not self.silent:
-            print(f"distributed: rank {rank}/{nproc} via {coord}, "
+        mlog.info(f"distributed: rank {rank}/{nproc} via {coord}, "
                   f"{len(__import__('jax').devices())} global devices")
 
     def init(self) -> None:
         self._maybe_init_distributed()
         if self.task == "train" and self.continue_training:
             if self._sync_latest_model():
-                print(f"Init: Continue training from round {self.start_counter}")
+                mlog.notice(
+                    f"Init: Continue training from round {self.start_counter}")
                 self._create_iterators()
                 return
             raise RuntimeError(
@@ -270,6 +286,7 @@ class LearnTask:
 
     def task_train(self) -> None:
         start = time.time()
+        metrics = self.net.metrics
         if self.continue_training == 0 and self.name_model_in == "NULL":
             self._save_model()
         if self.synth_device_data:
@@ -281,26 +298,36 @@ class LearnTask:
                 "section; add one (see example/MNIST/MNIST.conf) or use the "
                 "wrapper API for in-memory data")
         if self.test_io:
-            print("start I/O test")
+            mlog.notice("start I/O test")
         cc = self.max_round
         rounds_done = 0
-        tracing = False
-        # profile the second round (past compilation) — or the only round
-        # when just one will run
+        prof = ProfileWindow(self.prof_dir, self.prof_start_step,
+                             self.prof_num_steps)
+        # legacy window: profile the second round (past compilation) — or
+        # the only round when just one will run; prof_start_step >= 0
+        # pins the window to an exact global update step instead
         will_run = min(self.num_round - self.start_counter + 1,
                        self.max_round)
         prof_round = 1 if will_run > 1 else 0
+        # prof_start_step / prof_num_steps both count DISPATCHES (a
+        # multi_step group is one); trainer.sample_counter counts update
+        # steps, which diverges from dispatches under grouping
+        global_dispatch = 0
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
-            if not self.silent:
-                print(f"update round {self.start_counter - 1}", flush=True)
-            if self.prof_dir and rounds_done == prof_round:
-                import jax
-                jax.profiler.start_trace(self.prof_dir)
-                tracing = True
+            mlog.info(f"update round {self.start_counter - 1}")
+            prof.maybe_start_round(rounds_done, prof_round)
+            round_t0 = time.time()
             sample_counter = 0
+            n_round = 0
             t_mark = time.time()
             n_mark = 0
+            # host wall split for input-bound detection: time blocked on
+            # the iterator vs time spent dispatching steps (dispatch is
+            # async past compilation — a dispatch >> iter_wait round is
+            # device-bound; the reverse is starving on input)
+            iter_wait = dispatch_sec = 0.0
+            iter_wait_mark = dispatch_mark = 0.0
             self.net.start_round(self.start_counter)
             self.itr_train.before_first()
             # multi_step > 1 groups K batches into ONE device dispatch
@@ -310,15 +337,19 @@ class LearnTask:
             # (outputs come back stacked, one D2H per group)
             # pairtest nets stay on the per-batch path: grouped dispatch
             # would drop their step diagnostics (reference exceedance
-            # reporting)
+            # reporting); monitored nets too (the scan path carries no
+            # per-layer norm outputs)
             group_n = self.multi_step if (
                 self.multi_step > 1 and self.test_io == 0
                 and self.net.update_period == 1
-                and not self.net.has_diagnostics) else 1
+                and not self.net.has_diagnostics
+                and not self.net.monitor) else 1
             pending = []
             done = False
             while not done:
+                t0 = time.perf_counter()
                 batch = self.itr_train.next()
+                iter_wait_mark += time.perf_counter() - t0
                 if batch is None:
                     done = True
                 else:
@@ -327,7 +358,12 @@ class LearnTask:
                 if not flush or not pending:
                     continue
                 group, pending = pending, []
+                first_dispatch = False
                 if self.test_io == 0:
+                    prof.maybe_start_step(global_dispatch)
+                    global_dispatch += 1
+                    first_dispatch = self.compile_sec is None
+                    t0 = time.perf_counter()
                     # extra-data inputs aren't threaded through the scan
                     # path; fall back to per-batch dispatch for them.  A
                     # short final batch (round_batch=0) can't be stacked
@@ -343,25 +379,59 @@ class LearnTask:
                     else:
                         for b in group:
                             self.net.update(b)
+                    dt = time.perf_counter() - t0
+                    if first_dispatch:
+                        # jit traces + compiles synchronously inside the
+                        # first dispatch: report it separately and keep it
+                        # out of the steady-state examples/sec window (the
+                        # old code silently folded it into the first one)
+                        self.compile_sec = dt
+                        metrics.emit("compile", compile_sec=round(dt, 3),
+                                     round=self.start_counter - 1)
+                        mlog.info(f"compile: {dt:.1f} sec (first dispatch, "
+                                  "excluded from examples/sec)")
+                        t_mark, n_mark = time.time(), 0
+                    else:
+                        dispatch_mark += dt
+                    if prof.after_step():
+                        mlog.info(
+                            f"profile trace written to {self.prof_dir}")
                 for b in group:
                     sample_counter += 1
-                    n_mark += b.batch_size - b.num_batch_padd
-                    if sample_counter % self.print_step == 0 \
-                            and not self.silent:
+                    n_real = b.batch_size - b.num_batch_padd
+                    n_round += n_real
+                    if not first_dispatch:
+                        n_mark += n_real
+                    if sample_counter % self.print_step == 0:
                         now = time.time()
                         rate = n_mark / max(now - t_mark, 1e-9)
+                        if metrics.active and self.test_io == 0:
+                            loss = getattr(self.net, "_last_loss", None)
+                            metrics.emit(
+                                "step", round=self.start_counter - 1,
+                                step=sample_counter,
+                                global_step=self.net.sample_counter,
+                                elapsed_sec=round(now - start, 3),
+                                examples_per_sec=round(rate, 1),
+                                iter_wait_sec=round(iter_wait_mark, 4),
+                                dispatch_sec=round(dispatch_mark, 4),
+                                loss=None if loss is None
+                                else float(np.asarray(loss)))
                         t_mark, n_mark = now, 0
-                        print(f"round {self.start_counter - 1:8d}:"
-                              f"[{sample_counter:8d}] {int(now - start)} sec "
-                              f"elapsed, {rate:.1f} examples/sec", flush=True)
+                        iter_wait += iter_wait_mark
+                        dispatch_sec += dispatch_mark
+                        iter_wait_mark = dispatch_mark = 0.0
+                        mlog.info(
+                            f"round {self.start_counter - 1:8d}:"
+                            f"[{sample_counter:8d}] {int(now - start)} sec "
+                            f"elapsed, {rate:.1f} examples/sec")
                         self._report_diagnostics()
-            if tracing:
-                import jax
-                jax.profiler.stop_trace()
-                tracing = False
-                if not self.silent:
-                    print(f"profile trace written to {self.prof_dir}")
+            if prof.round_end():
+                mlog.info(f"profile trace written to {self.prof_dir}")
             rounds_done += 1
+            iter_wait += iter_wait_mark
+            dispatch_sec += dispatch_mark
+            train_wall = time.time() - round_t0
             if self.test_on_server:
                 # per-round replica consistency check (the reference's
                 # test_on_server weight check, async_updater-inl.hpp:144-154)
@@ -369,6 +439,7 @@ class LearnTask:
                 if drift != 0.0:
                     raise RuntimeError(
                         f"replica weights diverged (max abs diff {drift})")
+            round_metrics = {}
             if self.test_io == 0:
                 line = f"[{self.start_counter}]"
                 # only print the train metric when the trainer actually
@@ -376,12 +447,40 @@ class LearnTask:
                 # NetTrainer.update — a 0 here would print all-zero metrics)
                 if self.eval_train:
                     line += self.net.train_eval_line("train")
+                    round_metrics.update(
+                        self.net.train_metric.values("train"))
                 for it, name in zip(self.itr_evals, self.eval_names):
                     line += self.net.evaluate(it, name)
-                print(line, file=sys.stderr, flush=True)
+                    round_metrics.update(self.net.metric.values(name))
+                mlog.result(line)
+            if metrics.active:
+                rec = dict(round=self.start_counter,
+                           wall_sec=round(train_wall, 3),
+                           eval_sec=round(
+                               time.time() - round_t0 - train_wall, 3),
+                           examples=n_round,
+                           examples_per_sec=round(
+                               n_round / max(train_wall, 1e-9), 1),
+                           iter_wait_sec=round(iter_wait, 3),
+                           dispatch_sec=round(dispatch_sec, 3),
+                           train_step_traces=metrics.counters.get(
+                               "train_step_traces", 0),
+                           eval_step_traces=metrics.counters.get(
+                               "eval_step_traces", 0),
+                           **round_metrics)
+                if rounds_done == 1 and self.compile_sec is not None:
+                    rec["compile_sec"] = round(self.compile_sec, 3)
+                rec.update(self.net.memory_gauges())
+                metrics.emit("round", **rec)
             self._save_model()
-        if not self.silent:
-            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+        if prof.active:
+            # a step-bounded window the run never filled (prof_num_steps
+            # past the last dispatch, or test_io=1): flush it rather than
+            # leave the profiler running into process exit
+            prof.stop()
+            mlog.info(f"profile trace written to {self.prof_dir} "
+                      "(window truncated at training end)")
+        mlog.info(f"\nupdating end, {int(time.time() - start)} sec in all")
 
     def _train_synth_device(self) -> None:
         """synth_device_data=1: run the REAL config-driven train loop on
@@ -407,13 +506,16 @@ class LearnTask:
             losses = net.update_many(datas, labels)
             np.asarray(losses)
             dt = time.time() - t0
-            if not self.silent:
-                print(f"round {self.start_counter - 1:8d}: synth-device "
-                      f"{k} steps, {shape[0] * k / dt:.1f} examples/sec",
-                      flush=True)
+            mlog.info(f"round {self.start_counter - 1:8d}: synth-device "
+                      f"{k} steps, {shape[0] * k / dt:.1f} examples/sec")
+            net.metrics.emit(
+                "step", round=self.start_counter - 1, step=k,
+                global_step=net.sample_counter, synth_device=1,
+                examples_per_sec=round(shape[0] * k / dt, 1),
+                dispatch_sec=round(dt, 4), iter_wait_sec=0.0,
+                loss=float(np.asarray(losses[-1])))
             self._save_model()
-        if not self.silent:
-            print(f"\nupdating end, {int(time.time() - start)} sec in all")
+        mlog.info(f"\nupdating end, {int(time.time() - start)} sec in all")
 
     def _update_group(self, group) -> None:
         """Dispatch a group of batches as one on-device multi-step scan,
@@ -446,14 +548,14 @@ class LearnTask:
             parts.append(f"{k}={v:.3g}")
             if k.endswith("_rel_err") and not v <= PAIRTEST_RTOL:
                 bad.append(f"{k}: err={v:g} exceeds {PAIRTEST_RTOL:g}")
-        print("diag: " + " ".join(parts), flush=True)
+        mlog.info("diag: " + " ".join(parts))
         for b in bad:
-            print(b, file=sys.stderr, flush=True)
+            mlog.warn(b)
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
-        print("start predicting...")
+        mlog.notice("start predicting...")
         with open(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
             while True:
@@ -463,7 +565,7 @@ class LearnTask:
                 pred = self.net.predict(batch)
                 for v in pred:
                     fo.write(f"{v:g}\n")
-        print(f"finished prediction, write into {self.name_pred}")
+        mlog.notice(f"finished prediction, write into {self.name_pred}")
 
     def task_predict_raw(self) -> None:
         """task=pred_raw: write full output rows (e.g. softmax probabilities)
@@ -471,7 +573,7 @@ class LearnTask:
         cxxnet_main.cpp TaskPredictRaw)."""
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
-        print("start predicting raw scores...")
+        mlog.notice("start predicting raw scores...")
         with open(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
             while True:
@@ -481,14 +583,14 @@ class LearnTask:
                 out = self.net.predict_raw(batch)
                 for row in out:
                     fo.write(" ".join(f"{v:g}" for v in row) + "\n")
-        print(f"finished prediction, write into {self.name_pred}")
+        mlog.notice(f"finished prediction, write into {self.name_pred}")
 
     def task_extract(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a pred iterator for feature extraction"
         node = self.extract_node_name
         assert node, "must set extract_node_name"
-        print(f"start extracting feature from node {node} ...")
+        mlog.notice(f"start extracting feature from node {node} ...")
         binary = self.output_format == 0
         with open(self.name_pred, "wb" if binary else "w") as fo:
             self.itr_pred.before_first()
@@ -510,11 +612,11 @@ class LearnTask:
                 else:
                     for row in feat:
                         fo.write(" ".join(f"{v:g}" for v in row) + "\n")
-        print(f"finished extraction, write into {self.name_pred}")
+        mlog.notice(f"finished extraction, write into {self.name_pred}")
 
     def run(self, argv: List[str]) -> int:
         if len(argv) < 1:
-            print("Usage: python -m cxxnet_tpu <config> [key=value ...]")
+            mlog.notice("Usage: python -m cxxnet_tpu <config> [key=value ...]")
             return 0
         for k, v in parse_config_file(argv[0]):
             self.set_param(k, v)
@@ -522,8 +624,7 @@ class LearnTask:
             self.set_param(k, v)
         try:
             self.init()
-            if not self.silent:
-                print("initializing end, start working")
+            mlog.info("initializing end, start working")
             if self.task in ("train", "finetune"):
                 self.task_train()
             elif self.task == "pred":

@@ -1,0 +1,32 @@
+"""Telemetry subsystem: structured metrics, logging, in-graph monitors,
+and profiler-trace parsing.
+
+The reference cxxnet had two observability surfaces: the updater-level
+monitor (per-layer ||w||/||dw|| printed during training, updater.h
+SetMonitor) and the examples/sec line whose health mirrored the
+ThreadBuffer's.  This package is their TPU-era rework:
+
+* :mod:`.log` — stdlib logging behind the exact line formats the CLI
+  always printed (``silent`` maps to log levels);
+* :mod:`.metrics` — :class:`MetricsRegistry` (counters / gauges /
+  histograms) with a JSONL sink (``metrics_sink = jsonl:<path>``);
+* :mod:`.ingraph` — per-layer weight/grad/update norms computed as cheap
+  scalars INSIDE the traced step (zero overhead when ``monitor = 0``:
+  the step jaxpr is unchanged, asserted in tests);
+* :mod:`.trace` — pure-python profiler-trace (xplane.pb) parser shared
+  by bench.py, tools/trace_summary.py, and the profiling window.
+
+See doc/monitor.md for the config surface and JSONL record schema.
+"""
+
+from __future__ import annotations
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised by the NaN/inf loss guard under ``monitor_nan = fatal``."""
+
+
+from . import log  # noqa: E402
+from .metrics import MetricsRegistry  # noqa: E402
+
+__all__ = ["MetricsRegistry", "TrainingDiverged", "log"]

@@ -1,0 +1,55 @@
+"""In-graph norm monitor: the reference's updater monitor, resurrected.
+
+The reference printed per-layer ``||w||``/``||dw||`` from inside the
+updater when monitoring was on (updater.h SetMonitor).  Here the norms
+are computed INSIDE the jitted train step — three f32 scalars per
+parameter leaf (weight norm, grad norm, update norm), stacked so the
+step returns one tiny ``(3,)`` array per leaf alongside the loss.  The
+reduction is one extra pass over the parameters, trivial next to
+fwd+bwd, and rides the existing per-step D2H.
+
+``monitor = 0`` traces none of this: the step builder only calls
+:func:`group_stats` when monitoring is on, so the lowered HLO is
+byte-identical to an unmonitored build (asserted in
+tests/test_monitor.py).
+
+The update norm uses the ACTUAL parameter delta (new - old), so the
+update/weight ratio reflects momentum/adam/LR-schedule effects, not the
+raw gradient — on a non-apply microstep of ``update_period > 1`` it is
+exactly 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..nnet.net import iter_param_leaves
+
+
+def _norm(x) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(x32 * x32))
+
+
+def group_stats(params, grads, new_params) -> Dict[str, jnp.ndarray]:
+    """Per-leaf ``[||w||, ||dw||, ||w_new - w||]`` stacks, keyed
+    ``"<param_key>/<tag>"`` (nested pairtest tags join with ``:``)."""
+    flat_w = dict(iter_param_leaves(params))
+    flat_g = dict(iter_param_leaves(grads))
+    flat_n = dict(iter_param_leaves(new_params))
+    return {name: jnp.stack([_norm(w), _norm(flat_g[name]),
+                             _norm(flat_n[name] - w)])
+            for name, w in flat_w.items()}
+
+
+def unpack_stats(host_stats) -> Dict[str, Dict[str, float]]:
+    """Host-side view of one step's monitor output: per-leaf
+    ``{w_norm, g_norm, u_norm, u_ratio}`` floats."""
+    out = {}
+    for name, v in host_stats.items():
+        w, g, u = (float(v[0]), float(v[1]), float(v[2]))
+        out[name] = {"w_norm": w, "g_norm": g, "u_norm": u,
+                     "u_ratio": u / (w + 1e-12)}
+    return out

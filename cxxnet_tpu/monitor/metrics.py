@@ -1,0 +1,178 @@
+"""MetricsRegistry: counters / gauges / histograms + the JSONL sink.
+
+One registry per trainer (``NetTrainer.metrics``).  Counters are also
+how jit-retrace detection works: the step builders bump
+``train_step_traces`` / ``eval_step_traces`` from INSIDE the traced
+python body, which executes once per trace — a count climbing past the
+expected compilations (base step, masked tail step) flags silent
+recompiles from ``round_batch = 0`` shape churn.
+
+Sink spec: ``metrics_sink = jsonl:<path>`` appends one JSON object per
+record, each stamped with ``ts`` (unix seconds) and ``kind``.  Records
+share field names with BENCH_*.json (``device_step_ms``,
+``step_ms_median``, ...) so one pandas/gnuplot pipeline reads both; see
+doc/monitor.md for the per-kind schema.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional, TextIO
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max + last) — enough to answer
+    "how long do dispatches take" without holding samples."""
+
+    __slots__ = ("count", "total", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.last: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self.last = v
+
+    def summary(self) -> Dict[str, float]:
+        out = {"count": self.count, "sum": self.total}
+        if self.count:
+            out.update(min=self.min, max=self.max,
+                       mean=self.total / self.count, last=self.last)
+        return out
+
+
+class JsonlSink:
+    def __init__(self, path: str):
+        self.path = path
+        self._fo: TextIO = open(path, "a")
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self._fo.write(json.dumps(record, sort_keys=True,
+                                  default=_jsonable) + "\n")
+        self._fo.flush()  # records must survive a fatal NaN abort
+
+    def close(self) -> None:
+        self._fo.close()
+
+
+def _jsonable(v):
+    """Last-resort coercion: numpy scalars and device arrays become
+    python floats; anything else becomes its repr (a record must never
+    kill the training step)."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def create_sink(spec: str) -> Optional[JsonlSink]:
+    """Parse a ``metrics_sink`` value.  Empty/"none"/"0" disable."""
+    if not spec or spec in ("none", "0"):
+        return None
+    if spec.startswith("jsonl:"):
+        return JsonlSink(spec[len("jsonl:"):])
+    raise ValueError(
+        f"metrics_sink = {spec!r}: expected jsonl:<path> (or none)")
+
+
+class MetricsRegistry:
+    """Counters, gauges, histograms, and an optional record sink."""
+
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self.sink: Optional[JsonlSink] = None
+
+    # ------------------------------------------------------------- config
+    def configure_sink(self, spec: str) -> None:
+        if self.sink is not None:
+            self.sink.close()
+        self.sink = create_sink(spec)
+
+    @property
+    def active(self) -> bool:
+        return self.sink is not None
+
+    # ----------------------------------------------------------- instruments
+    def counter_inc(self, name: str, n: int = 1) -> int:
+        self.counters[name] = self.counters.get(name, 0) + n
+        return self.counters[name]
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram()
+        h.observe(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {k: h.summary()
+                               for k, h in self.histograms.items()}}
+
+    # --------------------------------------------------------------- records
+    def emit(self, kind: str, **fields) -> None:
+        """Write one JSONL record (no-op without a sink).  Sink I/O
+        failures (disk full, path gone) disable the sink and warn instead
+        of propagating — telemetry must never kill a training run."""
+        if self.sink is None:
+            return
+        rec = {"ts": round(time.time(), 3), "kind": kind}
+        rec.update(fields)
+        try:
+            self.sink.write(rec)
+        except (OSError, ValueError) as e:  # ValueError: closed file
+            path = self.sink.path
+            try:
+                self.sink.close()
+            except (OSError, ValueError):
+                pass
+            self.sink = None
+            from . import log
+            log.warn(f"metrics sink {path}: {e}; telemetry disabled "
+                     "for the rest of the run")
+
+    def close(self) -> None:
+        if self.sink is not None:
+            self.sink.close()
+            self.sink = None
+
+
+def device_memory_gauges(devices) -> Dict[str, int]:
+    """HBM gauges from ``device.memory_stats()`` — max over the local
+    devices (the high-water device is the OOM risk).  Empty dict when the
+    backend doesn't report (CPU) — callers omit the fields rather than
+    write zeros that read as "no memory used"."""
+    peak, in_use = None, None
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        if "peak_bytes_in_use" in stats:
+            v = int(stats["peak_bytes_in_use"])
+            peak = v if peak is None else max(peak, v)
+        if "bytes_in_use" in stats:
+            v = int(stats["bytes_in_use"])
+            in_use = v if in_use is None else max(in_use, v)
+    out = {}
+    if peak is not None:
+        out["hbm_peak_bytes"] = peak
+    if in_use is not None:
+        out["hbm_bytes_in_use"] = in_use
+    return out

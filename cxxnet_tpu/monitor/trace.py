@@ -1,0 +1,283 @@
+"""Profiler-trace (xplane.pb) parsing + the generalized profiling window.
+
+One implementation shared by bench.py (device step time), the telemetry
+round records, and tools/trace_summary.py — the round-6 BASELINE work
+hand-rolled this parse twice; third time it's a library.
+
+The parser is a minimal protobuf wire-format decoder for the XSpace
+proto (tensorflow/tsl/profiler/protobuf/xplane.proto), reading only the
+fields the tools need: plane/line names, event metadata names, and event
+durations.  No tensorflow import — the bench container has TF, the test
+container might not, and a 600 MB dependency for four varint fields is
+the wrong trade.  Field numbers verified against the installed proto:
+XSpace.planes=1; XPlane.name=2/lines=3/event_metadata=4 (map: key=1,
+value=2); XLine.name=2/events=4; XEvent.metadata_id=1/duration_ps=3;
+XEventMetadata.id=1/name=2.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# --------------------------------------------------------------- wire format
+
+_WIRE_VARINT, _WIRE_I64, _WIRE_LEN, _WIRE_I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift, val = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint overflow (corrupt trace?)")
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    LEN fields yield the raw bytes; varints yield ints; fixed-width
+    fields yield raw bytes (unused here but skipped correctly)."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == _WIRE_VARINT:
+            val, i = _read_varint(buf, i)
+        elif wire == _WIRE_LEN:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == _WIRE_I64:
+            val = buf[i:i + 8]
+            i += 8
+        elif wire == _WIRE_I32:
+            val = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+# ----------------------------------------------------------------- xplane
+
+class XEvent:
+    __slots__ = ("metadata_id", "duration_ps")
+
+    def __init__(self, metadata_id: int, duration_ps: int):
+        self.metadata_id = metadata_id
+        self.duration_ps = duration_ps
+
+
+class XLine:
+    __slots__ = ("name", "events")
+
+    def __init__(self, name: str, events: List[XEvent]):
+        self.name = name
+        self.events = events
+
+
+class XPlane:
+    __slots__ = ("name", "lines", "event_names")
+
+    def __init__(self, name: str, lines: List[XLine],
+                 event_names: Dict[int, str]):
+        self.name = name
+        self.lines = lines
+        self.event_names = event_names
+
+
+def _parse_event(buf: bytes) -> XEvent:
+    mid = dur = 0
+    for field, _, val in _fields(buf):
+        if field == 1:
+            mid = val
+        elif field == 3:
+            dur = val
+    return XEvent(mid, dur)
+
+
+def _parse_line(buf: bytes) -> XLine:
+    name, events = "", []
+    for field, _, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 4:
+            events.append(_parse_event(val))
+    return XLine(name, events)
+
+
+def _parse_event_metadata_entry(buf: bytes) -> Tuple[int, str]:
+    """map<int64, XEventMetadata> entry -> (id, name)."""
+    key, name = 0, ""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            key = val
+        elif field == 2:
+            for f2, _, v2 in _fields(val):
+                if f2 == 2:
+                    name = v2.decode("utf-8", "replace")
+    return key, name
+
+
+def _parse_plane(buf: bytes) -> XPlane:
+    name, lines, event_names = "", [], {}
+    for field, _, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 3:
+            lines.append(_parse_line(val))
+        elif field == 4:
+            k, v = _parse_event_metadata_entry(val)
+            event_names[k] = v
+    return XPlane(name, lines, event_names)
+
+
+def parse_xspace(path: str) -> List[XPlane]:
+    """Parse one ``*.xplane.pb`` file into a list of planes."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    return [_parse_plane(val) for field, wire, val in _fields(buf)
+            if field == 1 and wire == _WIRE_LEN]
+
+
+def find_xplane(path: str) -> str:
+    """``path`` is either an ``.xplane.pb`` file or a profiler log dir
+    (the newest xplane under it wins — jax writes one per session)."""
+    if os.path.isfile(path):
+        return path
+    paths = glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {path!r}")
+    return max(paths, key=os.path.getmtime)
+
+
+# --------------------------------------------------------------- summaries
+
+def _matching_events(planes: List[XPlane], plane_filter: str,
+                     line_filter: str) -> Iterator[Tuple[XPlane, XEvent]]:
+    for plane in planes:
+        if plane_filter not in plane.name:
+            continue
+        for line in plane.lines:
+            if line_filter not in line.name:
+                continue
+            for ev in line.events:
+                yield plane, ev
+
+
+def total_ms_in(planes: List[XPlane], plane_filter: str = "TPU",
+                line_filter: str = "XLA Modules") -> float:
+    return sum(ev.duration_ps / 1e9
+               for _, ev in _matching_events(planes, plane_filter,
+                                             line_filter))
+
+
+def op_totals_in(planes: List[XPlane], plane_filter: str = "TPU",
+                 line_filter: str = "XLA Ops"
+                 ) -> Dict[str, Tuple[float, int]]:
+    out: Dict[str, List[float]] = {}
+    for plane, ev in _matching_events(planes, plane_filter, line_filter):
+        name = plane.event_names.get(ev.metadata_id, f"#{ev.metadata_id}")
+        cur = out.setdefault(name, [0.0, 0])
+        cur[0] += ev.duration_ps / 1e9
+        cur[1] += 1
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def device_total_ms(path: str, plane_filter: str = "TPU",
+                    line_filter: str = "XLA Modules") -> float:
+    """Total on-chip XLA-module time (ms) across matching device planes
+    — the bench.py "device step" numerator."""
+    return total_ms_in(parse_xspace(find_xplane(path)),
+                       plane_filter, line_filter)
+
+
+def op_totals(path: str, plane_filter: str = "TPU",
+              line_filter: str = "XLA Ops") -> Dict[str, Tuple[float, int]]:
+    """Aggregate per-op device time: op name -> (total_ms, count)."""
+    return op_totals_in(parse_xspace(find_xplane(path)),
+                        plane_filter, line_filter)
+
+
+def top_ops(path: str, k: int = 10, plane_filter: str = "TPU",
+            line_filter: str = "XLA Ops"
+            ) -> List[Tuple[str, float, int]]:
+    """Top-k ops by total device time: [(name, total_ms, count), ...]."""
+    totals = op_totals(path, plane_filter, line_filter)
+    ranked = sorted(((name, ms, n) for name, (ms, n) in totals.items()),
+                    key=lambda t: -t[1])
+    return ranked[:k]
+
+
+# --------------------------------------------------------- profiling window
+
+class ProfileWindow:
+    """Generalized profiler window over the train loop.
+
+    Replaces the hard-coded "trace the second round" block: with
+    ``prof_start_step >= 0`` the trace starts before global update step N
+    (steps count update dispatches across rounds) and runs
+    ``prof_num_steps`` steps (0 = to round end).  With the default
+    ``prof_start_step = -1`` the legacy behavior holds — the window opens
+    at the start of the round past compilation (the second round, or the
+    only round) — but ``prof_num_steps`` can now bound it.  One window
+    per run; all hooks are no-ops once it closed or when ``trace_dir``
+    is empty.
+    """
+
+    def __init__(self, trace_dir: str, start_step: int = -1,
+                 num_steps: int = 0):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self.active = False
+        self.done = False
+        self._steps_traced = 0
+
+    def _start(self) -> None:
+        import jax
+        jax.profiler.start_trace(self.trace_dir)
+        self.active = True
+
+    def maybe_start_round(self, rounds_done: int, prof_round: int) -> None:
+        """Round-boundary hook for the legacy whole-round window."""
+        if (self.trace_dir and self.start_step < 0 and not self.done
+                and not self.active and rounds_done == prof_round):
+            self._start()
+
+    def maybe_start_step(self, global_step: int) -> None:
+        """Pre-dispatch hook: opens a step-addressed window."""
+        if (self.trace_dir and self.start_step >= 0 and not self.done
+                and not self.active and global_step >= self.start_step):
+            self._start()
+
+    def after_step(self) -> bool:
+        """Post-dispatch hook; returns True when this step closed the
+        window (the caller logs the trace location)."""
+        if not self.active:
+            return False
+        self._steps_traced += 1
+        if self.num_steps and self._steps_traced >= self.num_steps:
+            self.stop()
+            return True
+        return False
+
+    def round_end(self) -> bool:
+        """Round-boundary hook; an unbounded window closes here."""
+        if self.active and not self.num_steps:
+            self.stop()
+            return True
+        return False
+
+    def stop(self) -> None:
+        import jax
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True

@@ -297,6 +297,29 @@ def _conv_over_segs(segs, w, stride, pad_y, pad_x):
     return out
 
 
+def iter_param_leaves(params):
+    """Flatten a params/grads pytree into ``(name, leaf)`` pairs, naming
+    leaves ``"<param_key>/<tag>"`` (nested pairtest groups join their tag
+    path with ``:``, matching get_weight's addressing).  Deterministic
+    order (dict insertion) so monitor records line up across steps."""
+    out = []
+
+    def walk(group, path):
+        for tag, p in group.items():
+            if isinstance(p, dict):
+                walk(p, f"{path}:{tag}")
+            else:
+                out.append((f"{path}:{tag}", p))
+
+    for pkey, group in params.items():
+        for tag, p in group.items():
+            if isinstance(p, dict):
+                walk(p, f"{pkey}/{tag}")
+            else:
+                out.append((f"{pkey}/{tag}", p))
+    return out
+
+
 def conn_params(params, conn):
     """Per-connection parameter view.  A max pool carrying a deferred
     conv bias (the trainer's relu/bias->pool reorder) reads the bias

@@ -37,6 +37,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import engine
 from ..io.data import DataBatch
 from ..layers.base import ForwardContext, LabelInfo, as_mat
+from ..monitor import TrainingDiverged, log as mlog
+from ..monitor.metrics import MetricsRegistry, device_memory_gauges
 from ..parallel import mesh as meshlib
 from ..updater import UpdaterHyper, create_updater
 from ..utils import serializer
@@ -106,6 +108,15 @@ class NetTrainer:
         # evaluate(): batches scanned per device dispatch (1 = per-batch);
         # one jit call + one D2H per group (VERDICT r3 weak 7)
         self.eval_group = 8
+        # telemetry (doc/monitor.md): monitor=1 adds per-layer norm
+        # scalars to the traced step (the reference's updater monitor);
+        # monitor_nan guards the loss against NaN/inf at monitor_interval
+        # cadence; metrics_sink=jsonl:<path> streams structured records
+        self.monitor = 0
+        self.monitor_interval = 100
+        self.monitor_nan = "warn"
+        self.metrics = MetricsRegistry()
+        self._last_monitor = None
         # metric bindings: (metric_name, label_field, node_name or "")
         self._metric_req: List[Tuple[str, str, str]] = []
         self.metric = MetricSet()
@@ -158,6 +169,17 @@ class NetTrainer:
             engine.set_engine_option(name, val)
         elif name == "silent":
             self.silent = int(val)
+            mlog.set_silent(self.silent)
+        elif name == "monitor":
+            self.monitor = int(val)
+        elif name == "monitor_interval":
+            self.monitor_interval = int(val)
+        elif name == "monitor_nan":
+            assert val in ("warn", "fatal", "off"), (
+                f"monitor_nan = {val}: expected warn, fatal, or off")
+            self.monitor_nan = val
+        elif name == "metrics_sink":
+            self.metrics.configure_sink(val)
         elif name == "eval_train":
             self.eval_train = int(val)
         elif name == "eval_group":
@@ -182,6 +204,7 @@ class NetTrainer:
 
     # ----------------------------------------------------------------- init
     def init_model(self) -> None:
+        mlog.set_silent(self.silent)  # this trainer owns the log level now
         netcfg = NetConfig()
         netcfg.configure(self.cfg)
         assert self.batch_size > 0, "batch_size must be set"
@@ -193,8 +216,7 @@ class NetTrainer:
         self.buffers = self.net.init_buffers()
         self._rng_base = jax.random.PRNGKey(self.seed)
         self._post_build()
-        if not self.silent:
-            print(self.net.describe())
+        mlog.info(self.net.describe())
 
     def _setup_mesh(self) -> None:
         """Device selection + mesh build, shared by init_model and
@@ -274,6 +296,15 @@ class NetTrainer:
         self._grad_acc = None
         self.sample_counter = 0
         self.epoch_counter = 0
+        # run header for the JSONL sink: one record binding the stream to
+        # the config it measures (engine opts at configure time; the
+        # trace-time audit stays in engine_opts_used)
+        self.metrics.emit(
+            "run", updater=self.netcfg.updater_type,
+            batch_size=self.batch_size, dtype=str(jnp.dtype(self.dtype)),
+            mesh=dict(self.mesh.shape), monitor=self.monitor,
+            monitor_interval=self.monitor_interval,
+            monitor_nan=self.monitor_nan, engine_opts=engine.snapshot())
 
     def _make_shardings(self) -> None:
         mesh = self.mesh
@@ -514,10 +545,9 @@ class NetTrainer:
             skip.update(members[1:])
         self.net.fuse_groups = fuse
         self.net.fuse_skip = frozenset(skip)
-        if fuse and not self.silent:
-            print(f"conv_sibling_fuse: {len(fuse)} groups "
-                  f"({sum(len(m) for m in fuse.values())} convs)",
-                  flush=True)
+        if fuse:
+            mlog.info(f"conv_sibling_fuse: {len(fuse)} groups "
+                      f"({sum(len(m) for m in fuse.values())} convs)")
 
     def _setup_input_s2d(self):
         """Wire ``input_s2d = 1``: flag the first conv to consume
@@ -628,12 +658,12 @@ class NetTrainer:
             n_stage = self.mesh.shape["pipe"]
             stages, body_end = pipeline_net.partition_network(
                 self.net, n_stage)
-            if not self.silent:
+            if not mlog.is_silent():
                 desc = ", ".join(
                     "+".join(self.net.connections[j].layer.type_names[0]
                              for j in range(s0, s1))
                     for s0, s1 in stages)
-                print(f"pipeline: {n_stage} stages [{desc}]", flush=True)
+                mlog.info(f"pipeline: {n_stage} stages [{desc}]")
             self._pipe_partition = (stages, body_end)
         return self._pipe_partition
 
@@ -940,6 +970,17 @@ class NetTrainer:
         tail batch."""
         accumulate = self.update_period > 1
         eval_ids = tuple(dict.fromkeys(self.eval_node_ids))
+        # monitor=1 appends per-leaf norm stacks to the step outputs (the
+        # reference's updater monitor, doc/monitor.md).  With monitor=0
+        # the builder takes the exact pre-telemetry path: no extra
+        # outputs, no ingraph import, identical lowered HLO (asserted in
+        # tests/test_monitor.py)
+        monitored = bool(self.monitor)
+
+        def monitor_stats(params, grads, new_p):
+            from ..monitor import ingraph
+            return (ingraph.group_stats(params, grads, new_p),) \
+                if monitored else ()
 
         def loss_and_grads(params, buffers, data, label_vec, extras, epoch,
                            rng, mask):
@@ -954,19 +995,24 @@ class NetTrainer:
             return new_p, new_s, zeroed
 
         mask_shard = (self.batch_shard,) if with_mask else ()
+        mon_shard = (self.repl,) if monitored else ()
         if accumulate:
             def step(params, opt_state, buffers, grad_acc, data, label_vec,
                      extras, epoch, rng, do_update, *maskarg):
+                # trace-time side effect: runs once per compilation, so
+                # the counter exposes silent retraces (shape churn)
+                self.metrics.counter_inc("train_step_traces")
                 mask = maskarg[0] if with_mask else None
                 (loss, (new_buffers, outs, diags)), grads = loss_and_grads(
                     params, buffers, data, label_vec, extras, epoch, rng,
                     mask)
                 grads = jax.tree.map(jnp.add, grad_acc, grads)
-                params, opt_state, grads = jax.lax.cond(
+                new_p, new_s, new_grads = jax.lax.cond(
                     do_update, lambda op: apply_update(op, epoch),
                     lambda op: op, (params, opt_state, grads))
-                return (params, opt_state, new_buffers, grads,
-                        loss, outs, diags)
+                return (new_p, new_s, new_buffers, new_grads,
+                        loss, outs, diags) + monitor_stats(
+                            params, grads, new_p)
 
             shardings_in = (self.param_shardings, self.opt_shardings,
                             self.buffer_shardings, self.param_shardings,
@@ -975,19 +1021,21 @@ class NetTrainer:
                             self.repl) + mask_shard
             shardings_out = (self.param_shardings, self.opt_shardings,
                              self.buffer_shardings, self.param_shardings,
-                             self.repl, self.repl, self.repl)
+                             self.repl, self.repl, self.repl) + mon_shard
             return jax.jit(step, in_shardings=shardings_in,
                            out_shardings=shardings_out,
                            donate_argnums=(0, 1, 2, 3))
 
         def step(params, opt_state, buffers, data, label_vec,
                  extras, epoch, rng, *maskarg):
+            self.metrics.counter_inc("train_step_traces")
             mask = maskarg[0] if with_mask else None
             (loss, (new_buffers, outs, diags)), grads = loss_and_grads(
                 params, buffers, data, label_vec, extras, epoch, rng, mask)
-            params, opt_state, _ = apply_update(
+            new_p, new_s, _ = apply_update(
                 (params, opt_state, grads), epoch)
-            return params, opt_state, new_buffers, loss, outs, diags
+            return (new_p, new_s, new_buffers, loss, outs,
+                    diags) + monitor_stats(params, grads, new_p)
 
         shardings_in = (self.param_shardings, self.opt_shardings,
                         self.buffer_shardings,
@@ -995,7 +1043,7 @@ class NetTrainer:
                         self.batch_shard, self.repl, self.repl) + mask_shard
         shardings_out = (self.param_shardings, self.opt_shardings,
                          self.buffer_shardings,
-                         self.repl, self.repl, self.repl)
+                         self.repl, self.repl, self.repl) + mon_shard
         return jax.jit(step, in_shardings=shardings_in,
                        out_shardings=shardings_out,
                        donate_argnums=(0, 1, 2))
@@ -1037,6 +1085,7 @@ class NetTrainer:
                     (loss, outs))
 
         def run(params, opt_state, buffers, epoch, rng_base, datas, labels):
+            self.metrics.counter_inc("train_step_traces")
             carry = (params, opt_state, buffers, epoch, rng_base)
             carry, (losses, outs) = jax.lax.scan(
                 body, carry, (datas, labels))
@@ -1101,6 +1150,8 @@ class NetTrainer:
             return self._eval_many_cache[key]
 
         def run(params, buffers, datas):
+            self.metrics.counter_inc("eval_step_traces")
+
             def body(carry, data):
                 nodes, _, _ = self._forward(params, buffers, data, None, (),
                                             train=False, rng=None, epoch=0)
@@ -1123,6 +1174,7 @@ class NetTrainer:
             return self._eval_step_cache[node_ids]
 
         def estep(params, buffers, data, extras):
+            self.metrics.counter_inc("eval_step_traces")
             nodes, _, _ = self._forward(params, buffers, data, None, extras,
                                         train=False, rng=None, epoch=0)
             return {nid: as_mat(nodes[nid]).astype(jnp.float32)
@@ -1168,8 +1220,7 @@ class NetTrainer:
 
     def _note_engine_opts(self) -> None:
         if getattr(self, "engine_opts_used", None) is None:
-            self.engine_opts_used = {k: getattr(engine.opts, k)
-                                     for k in engine._DEFS}
+            self.engine_opts_used = engine.snapshot()
 
     def update(self, batch: DataBatch) -> None:
         self._note_engine_opts()
@@ -1207,21 +1258,65 @@ class NetTrainer:
         if self.update_period > 1:
             if getattr(self, "_grad_acc", None) is None:
                 self._grad_acc = self._grad_acc_init()
-            (self.params, self.opt_state, self.buffers, self._grad_acc,
-             loss, outs, diags) = step_fn(
+            out = step_fn(
                 self.params, self.opt_state, self.buffers, self._grad_acc,
                 data, label_vec, extras,
                 jnp.int32(epoch), rng, jnp.bool_(do_update), *maskarg)
+            (self.params, self.opt_state, self.buffers, self._grad_acc,
+             loss, outs, diags) = out[:7]
         else:
-            (self.params, self.opt_state, self.buffers,
-             loss, outs, diags) = step_fn(
+            out = step_fn(
                 self.params, self.opt_state, self.buffers,
                 data, label_vec, extras, jnp.int32(epoch), rng, *maskarg)
+            (self.params, self.opt_state, self.buffers,
+             loss, outs, diags) = out[:6]
         self._last_loss = loss
         self._last_outs = outs
         self._last_diags = diags
+        self._last_monitor = out[-1] if self.monitor else None
+        if self.monitor and self.monitor_interval > 0 \
+                and self.sample_counter % self.monitor_interval == 0:
+            self._monitor_tick(loss, self._last_monitor)
         if self.eval_train and self.train_metric.evals:
             self.accumulate_train_metric(outs, batch.label, n_padd=n_padd)
+
+    def _monitor_tick(self, loss, mon) -> None:
+        """Materialize one monitored step on the host: the NaN/inf loss
+        guard plus per-layer norm records and the reference-style monitor
+        line.  This is the step's one deliberate host sync — amortized by
+        ``monitor_interval`` (the unmonitored path stays fully async)."""
+        from ..monitor import ingraph
+        lval = float(np.asarray(loss))
+        # per-layer norms FIRST: on a fatal NaN these are exactly the
+        # diagnostics worth having (which layer blew up), and the sink
+        # flushes per record, so they survive the raise below
+        stats = ingraph.unpack_stats(
+            {k: np.asarray(v) for k, v in mon.items()})
+        for name, s in stats.items():
+            self.metrics.emit("monitor", step=self.sample_counter,
+                              round=self.round, layer=name, **s)
+        if not mlog.is_silent():  # skip the string build when suppressed
+            parts = " ".join(
+                f"{name}[|w|={s['w_norm']:.4g},|dw|={s['g_norm']:.4g},"
+                f"u/w={s['u_ratio']:.3g}]" for name, s in stats.items())
+            mlog.info(f"monitor[{self.sample_counter}] "
+                      f"loss={lval:.6g} {parts}")
+        if not np.isfinite(lval) and self.monitor_nan != "off":
+            msg = (f"monitor: non-finite loss {lval} at step "
+                   f"{self.sample_counter} (round {self.round}); "
+                   f"monitor_nan={self.monitor_nan}")
+            self.metrics.counter_inc("nonfinite_loss_steps")
+            self.metrics.emit("nan", step=self.sample_counter,
+                              round=self.round, loss=lval,
+                              action=self.monitor_nan)
+            if self.monitor_nan == "fatal":
+                raise TrainingDiverged(msg)
+            mlog.warn(msg)
+
+    def memory_gauges(self) -> Dict[str, int]:
+        """HBM high-water gauges over this trainer's devices (empty on
+        backends without memory_stats, e.g. CPU)."""
+        return device_memory_gauges(self.devices)
 
     def accumulate_train_metric(self, outs, label, n_padd: int = 0) -> None:
         """Add one batch's eval-node outputs to the train metric (shared by
@@ -1426,6 +1521,7 @@ class NetTrainer:
             extra_meta={"round": self.round})
 
     def load_model(self, path: str) -> None:
+        mlog.set_silent(self.silent)
         header, params, buffers, opt = serializer.load_model(path)
         netcfg = NetConfig.from_dict(header["net"])
         # re-apply the current session's config on top of the checkpoint's:
@@ -1470,8 +1566,7 @@ class NetTrainer:
                         self.param_shardings[pkey])
                     self._refresh_masters(pkey)
                     copied.append(name)
-        if not self.silent:
-            print(f"copy_model_from: copied layers {copied}")
+        mlog.info(f"copy_model_from: copied layers {copied}")
 
     # ------------------------------------------------------------- checking
     def check_weight_consistency(self) -> float:

@@ -138,3 +138,9 @@ class MetricSet:
 
     def print_line(self, evname: str) -> str:
         return "".join(f"\t{evname}-{m.name}:{m.get():f}" for m in self.evals)
+
+    def values(self, evname: str) -> Dict[str, float]:
+        """Structured twin of :meth:`print_line` for the JSONL sink:
+        ``{"<evname>-<metric>": value}`` with the same key spelling as
+        the printed fragments."""
+        return {f"{evname}-{m.name}": m.get() for m in self.evals}

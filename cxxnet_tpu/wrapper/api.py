@@ -11,13 +11,13 @@ through a C shim just to come back into Python.
 
 from __future__ import annotations
 
-import sys
 from typing import Optional
 
 import numpy as np
 
 from ..io.data import DataBatch
 from ..io.factory import create_iterator, init_iterator
+from ..monitor import log as mlog
 from ..nnet.trainer import NetTrainer
 from ..utils.config import parse_config_string
 
@@ -175,9 +175,9 @@ def train(cfg: str, data, num_round: int, param, eval_data=None,
                 net.update(data)
                 scounter += 1
                 if scounter % 100 == 0:
-                    print(f"[{r}] {scounter} batch passed")
+                    mlog.notice(f"[{r}] {scounter} batch passed")
         else:
             net.update(data=data, label=label)
         if eval_data is not None:
-            print(net.evaluate(eval_data, "eval"), file=sys.stderr)
+            mlog.result(net.evaluate(eval_data, "eval"))
     return net

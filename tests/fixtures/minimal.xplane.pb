@@ -1,0 +1,4 @@
+
+¥/device:TPU:0XLA Modules"€ä—Ð0XLA Ops"€”ëÜ"€Êµî"€„¯_"€¼Á–"jit_step"convolution.3"
+copy.2"fusion.1
+2	/host:CPUXLA Ops"	€Œî‰"		hostloop

@@ -1,0 +1,125 @@
+"""Shared xplane trace parser (cxxnet_tpu/monitor/trace.py) and the
+tools/trace_summary.py CLI, against the checked-in minimal fixture
+(tests/fixtures/minimal.xplane.pb: one TPU plane with an XLA Modules
+line [jit_step 5 ms] and an XLA Ops line [fusion.1 x2 = 1.5 ms,
+copy.2 0.2 ms, convolution.3 3.0 ms], plus a host plane that the
+default filters must exclude)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.monitor.trace import (device_total_ms, find_xplane,
+                                      op_totals, parse_xspace, top_ops)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "minimal.xplane.pb")
+
+
+def test_parse_planes_and_metadata():
+    planes = parse_xspace(FIXTURE)
+    assert [p.name for p in planes] == ["/device:TPU:0", "/host:CPU"]
+    tpu = planes[0]
+    assert [l.name for l in tpu.lines] == ["XLA Modules", "XLA Ops"]
+    assert tpu.event_names == {1: "fusion.1", 2: "copy.2",
+                               3: "convolution.3", 4: "jit_step"}
+
+
+def test_device_total_and_op_totals():
+    assert device_total_ms(FIXTURE) == pytest.approx(5.0)
+    totals = op_totals(FIXTURE)
+    assert totals == {"fusion.1": (pytest.approx(1.5), 2),
+                      "copy.2": (pytest.approx(0.2), 1),
+                      "convolution.3": (pytest.approx(3.0), 1)}
+    # the host plane is excluded by the TPU filter but reachable
+    assert device_total_ms(FIXTURE, plane_filter="CPU",
+                           line_filter="XLA Ops") == pytest.approx(7.0)
+
+
+def test_top_ops_ranking():
+    assert [(n, round(ms, 3)) for n, ms, _ in top_ops(FIXTURE, k=2)] == \
+        [("convolution.3", 3.0), ("fusion.1", 1.5)]
+
+
+def test_find_xplane_dir_and_missing(tmp_path):
+    sub = tmp_path / "a" / "b"
+    sub.mkdir(parents=True)
+    dst = sub / "t.xplane.pb"
+    dst.write_bytes(open(FIXTURE, "rb").read())
+    assert find_xplane(str(tmp_path)) == str(dst)
+    with pytest.raises(FileNotFoundError):
+        find_xplane(str(tmp_path / "empty-nothing"))
+
+
+def test_parser_agrees_with_tensorflow_proto():
+    """The pure-python wire decoder reads exactly what the canonical
+    proto implementation reads (skipped where TF is absent)."""
+    xplane_pb2 = pytest.importorskip(
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(FIXTURE, "rb").read())
+    ref = 0.0
+    for plane in xs.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if "XLA Modules" not in line.name:
+                continue
+            for ev in line.events:
+                ref += ev.duration_ps / 1e9
+    assert device_total_ms(FIXTURE) == pytest.approx(ref)
+
+
+def test_cli_table_and_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_summary.py"),
+         FIXTURE, "--top", "2"],
+        check=True, capture_output=True, text=True, cwd=REPO).stdout
+    assert "device total" in out and "5.000 ms" in out
+    assert "convolution.3" in out and "fusion.1" in out
+    assert "copy.2" not in out  # below top-2, reported as dropped
+    assert "1 more ops" in out
+    js = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_summary.py"),
+         FIXTURE, "--json"],
+        check=True, capture_output=True, text=True, cwd=REPO).stdout
+    payload = json.loads(js)
+    assert payload["device_total_ms"] == 5.0
+    assert payload["top_ops"][0] == {"op": "convolution.3",
+                                     "total_ms": 3.0, "count": 1}
+
+
+def test_cli_missing_trace_errors(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_summary.py"),
+         str(tmp_path)], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    assert "no *.xplane.pb" in r.stderr
+
+
+def test_bench_shares_parser(tmp_path):
+    """bench.py's device-time path reads through the same module."""
+    import bench
+    sub = tmp_path / "plugins"
+    sub.mkdir()
+    (sub / "x.xplane.pb").write_bytes(open(FIXTURE, "rb").read())
+    assert bench._trace_device_ms(str(tmp_path)) == pytest.approx(5.0)
+
+
+def test_bench_emits_sink_record(tmp_path):
+    import bench
+    sink = tmp_path / "bench.jsonl"
+    payload = bench.baseline_json(1234.5, {"device_step_ms": 42.0})
+    bench.emit_bench_record(payload, argv=[f"metrics_sink=jsonl:{sink}"])
+    (rec,) = [json.loads(l) for l in open(sink)]
+    assert rec["kind"] == "bench"
+    assert rec["metric"] == "alexnet_imgs_per_sec_per_chip"
+    assert rec["device_step_ms"] == 42.0
+    # no spec -> no write
+    bench.emit_bench_record(payload, argv=[])
+    assert len(open(sink).readlines()) == 1

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Top-k ops by device time from a jax profiler trace.
+
+Shares the xplane parser with bench.py and the telemetry layer
+(cxxnet_tpu/monitor/trace.py) — one implementation of the parse the
+round-6 BASELINE work hand-rolled twice.
+
+    python tools/trace_summary.py /tmp/prof                 # newest trace
+    python tools/trace_summary.py trace.xplane.pb --top 30
+    python tools/trace_summary.py /tmp/prof --plane CPU --line XLA
+    python tools/trace_summary.py /tmp/prof --json          # machine-readable
+
+Typical triage: run training with ``prof = /tmp/prof`` (optionally
+``prof_start_step``/``prof_num_steps`` for an exact window), then point
+this tool at the directory.  The per-op table names the line to attack;
+``device total`` is the bench-comparable on-chip step time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.monitor.trace import (find_xplane, op_totals_in,  # noqa: E402
+                                      parse_xspace, total_ms_in)
+
+
+def summarize(path: str, top: int, plane: str, line: str) -> dict:
+    xplane = find_xplane(path)
+    planes = parse_xspace(xplane)  # parse ONCE; both views read from it
+    totals = op_totals_in(planes, plane_filter=plane, line_filter=line)
+    ranked = sorted(((name, ms, n) for name, (ms, n) in totals.items()),
+                    key=lambda t: -t[1])
+    out = {
+        "trace": xplane,
+        "plane_filter": plane,
+        "line_filter": line,
+        "device_total_ms": round(
+            total_ms_in(planes, plane_filter=plane), 3),
+        "ops_total_ms": round(sum(ms for _, (ms, _) in totals.items()), 3),
+        "top_ops": [{"op": name, "total_ms": round(ms, 3), "count": n}
+                    for name, ms, n in ranked[:top]],
+        "dropped_ops": max(len(ranked) - top, 0),
+    }
+    if not ranked:
+        # nothing matched the filters (e.g. a CPU-runtime trace whose
+        # lines aren't named "XLA Ops"): show what IS there instead of a
+        # silent empty table
+        out["available"] = [
+            {"plane": p.name, "lines": [l.name for l in p.lines]}
+            for p in planes]
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="top-k ops by device time from a profiler trace")
+    ap.add_argument("trace", help="profiler log dir or *.xplane.pb file")
+    ap.add_argument("--top", type=int, default=20, help="rows to print")
+    ap.add_argument("--plane", default="TPU",
+                    help="substring filter on plane names (default TPU; "
+                    "use CPU for host-emulated traces)")
+    ap.add_argument("--line", default="XLA Ops",
+                    help="substring filter on line names")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON object instead of the table")
+    args = ap.parse_args(argv)
+    try:
+        s = summarize(args.trace, args.top, args.plane, args.line)
+    except FileNotFoundError as e:
+        print(f"trace_summary: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(s))
+        return 0
+    print(f"trace: {s['trace']}")
+    print(f"device total (XLA Modules, plane~{args.plane}): "
+          f"{s['device_total_ms']:.3f} ms")
+    ops_total = s["ops_total_ms"] or 1e-12
+    print(f"{'total_ms':>12} {'count':>8} {'%ops':>6}  op")
+    for row in s["top_ops"]:
+        print(f"{row['total_ms']:12.3f} {row['count']:8d} "
+              f"{100.0 * row['total_ms'] / ops_total:6.1f}  {row['op']}")
+    if s["dropped_ops"]:
+        print(f"... {s['dropped_ops']} more ops below top-{args.top} "
+              f"(--top to widen)")
+    if not s["top_ops"] and s.get("available"):
+        print(f"no events matched --plane {args.plane!r} "
+              f"--line {args.line!r}; the trace contains:")
+        for a in s["available"]:
+            print(f"  plane {a['plane']!r}: lines {a['lines']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
