@@ -308,7 +308,118 @@ def bench_transformer():
     return tok_s, extras
 
 
+IO_AB_NET = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 5
+  stride = 2
+  nchannel = 16
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:fc1
+  nhidden = 10
+layer[4->4] = softmax
+netconfig=end
+"""
+
+
+def bench_io_ab(argv=None) -> dict:
+    """``--io-ab``: input-pipeline A/B at the device boundary — the
+    ``test_io=1`` twin that KEEPS the device work.  Trains the same small
+    conv net over the same synthetic dataset with ``prefetch_device=2``
+    vs ``0`` and reports batches/sec plus where the host wall went:
+    ``h2d_sec`` (staging, off the critical path when prefetching) and the
+    iterator-wait share of the round wall.  Overridable via ``key=value``
+    args: ``dev`` (default tpu), ``batch_size``, ``n_inst``,
+    ``num_round``."""
+    import os
+    import tempfile
+
+    from cxxnet_tpu.main import LearnTask
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import make_synth_mnist as sm
+    args = dict(a.split("=", 1)
+                for a in (argv or []) if "=" in a)
+    dev = args.get("dev", "tpu")
+    batch = int(args.get("batch_size", "64"))
+    n = int(args.get("n_inst", "2048"))
+    num_round = int(args.get("num_round", "3"))
+    side = 24
+    rnd = np.random.RandomState(0)
+    labels = rnd.randint(0, 10, n)
+    imgs = np.stack([
+        np.clip(sm.class_pattern(l, side, side) * 255
+                + rnd.rand(side, side) * 32, 0, 255) for l in labels])
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        sm.write_idx_images(os.path.join(tmp, "img.gz"), imgs)
+        sm.write_idx_labels(os.path.join(tmp, "lbl.gz"), labels)
+        conf = os.path.join(tmp, "ab.conf")
+        with open(conf, "w") as f:
+            f.write(f"""
+dev = {dev}
+data = train
+iter = mnist
+  input_flat = 0
+  path_img = {tmp}/img.gz
+  path_label = {tmp}/lbl.gz
+iter = end
+{IO_AB_NET}
+input_shape = 1,{side},{side}
+batch_size = {batch}
+eta = 0.01
+num_round = {num_round}
+metric = error
+eval_train = 0
+save_model = 0
+silent = 1
+print_step = 1000000
+""")
+        for tag, pf in (("on", 2), ("off", 0)):
+            sink = os.path.join(tmp, f"metrics_{tag}.jsonl")
+            task = LearnTask()
+            rc = task.run([conf, f"prefetch_device={pf}",
+                           f"metrics_sink=jsonl:{sink}"])
+            assert rc == 0, f"io-ab training failed (prefetch={pf})"
+            recs = [json.loads(l) for l in open(sink)]
+            rounds = [r for r in recs if r["kind"] == "round"]
+            # steady state: drop the compile round when more than one ran
+            steady = rounds[1:] or rounds
+            wall = max(sum(r["wall_sec"] for r in steady), 1e-9)
+            batches = sum(r["examples"] for r in steady) / batch
+            out[f"batches_per_sec_{tag}"] = round(batches / wall, 2)
+            out[f"h2d_sec_{tag}"] = round(
+                sum(r["h2d_sec"] for r in steady), 4)
+            out[f"iter_wait_share_{tag}"] = round(
+                sum(r["iter_wait_sec"] for r in steady) / wall, 4)
+            out[f"dispatch_share_{tag}"] = round(
+                sum(r["dispatch_sec"] for r in steady) / wall, 4)
+    print(f"bench: io-ab {out['batches_per_sec_on']:.1f} batches/sec "
+          f"prefetched vs {out['batches_per_sec_off']:.1f} synchronous "
+          f"(h2d {out['h2d_sec_on']:.3f}s overlapped vs "
+          f"{out['h2d_sec_off']:.3f}s on the critical path)",
+          file=sys.stderr)
+    return {
+        "metric": "io_ab_batches_per_sec",
+        "value": out["batches_per_sec_on"],
+        "unit": "batches/sec",
+        "vs_prefetch_off": round(
+            out["batches_per_sec_on"]
+            / max(out["batches_per_sec_off"], 1e-9), 3),
+        **out,
+    }
+
+
 def main() -> None:
+    if "--io-ab" in sys.argv[1:]:
+        payload = bench_io_ab([a for a in sys.argv[1:] if a != "--io-ab"])
+        try:
+            emit_bench_record(payload)
+        except Exception as e:  # the sink must never break the payload
+            print(f"bench: metrics sink failed: {e}", file=sys.stderr)
+        print(json.dumps(payload))
+        return
     import jax
     from __graft_entry__ import ALEXNET_NET, _make_trainer
 

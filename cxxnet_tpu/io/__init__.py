@@ -1,5 +1,8 @@
 from .data import DataBatch, DataInst, IIterator
+from .device_prefetch import (DevicePrefetcher, StagedBatch, StagedEvalGroup,
+                              StagedGroup, StagedMeta, item_h2d_sec)
 from .factory import create_iterator, init_iterator
 
 __all__ = ["DataBatch", "DataInst", "IIterator", "create_iterator",
-           "init_iterator"]
+           "init_iterator", "DevicePrefetcher", "StagedBatch",
+           "StagedGroup", "StagedEvalGroup", "StagedMeta", "item_h2d_sec"]

@@ -19,6 +19,7 @@ from typing import List, Optional
 import numpy as np
 
 from .data import DataBatch, DataInst, IIterator
+from .device_prefetch import ProducerError, generation_put
 
 _AUG_RAND_MAGIC = 111
 
@@ -354,7 +355,9 @@ class ThreadBufferIterator(IIterator):
     Each epoch gets its own queue + producer thread; a generation counter
     poisons stale producers, and before_first() joins the previous producer
     before rewinding the (shared) base iterator, so exactly one thread ever
-    touches the base.
+    touches the base.  A producer exception is enqueued and re-raised in
+    the consumer's next() — the epoch is dead until the next
+    before_first(), never a hang.
     """
 
     def __init__(self, base: IIterator, max_buffer: int = 4):
@@ -363,6 +366,7 @@ class ThreadBufferIterator(IIterator):
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._gen = 0
+        self._failed: Optional[BaseException] = None
 
     def set_param(self, name, val):
         if name == "buffer_size":
@@ -378,22 +382,18 @@ class ThreadBufferIterator(IIterator):
 
     def _producer(self, gen: int, q: "queue.Queue"):
         while True:
-            b = self.base.next()
-            # bounded put that re-checks the generation so a stale producer
-            # exits instead of blocking forever on an orphaned queue
-            while True:
-                if self._gen != gen:
-                    return
-                try:
-                    q.put(b, timeout=0.05)
-                    break
-                except queue.Full:
-                    continue
-            if b is None:
+            try:
+                b = self.base.next()
+            except BaseException as e:  # noqa: BLE001 — reach the consumer
+                b = ProducerError(e)
+            if not generation_put(self, gen, q, b):
+                return
+            if b is None or isinstance(b, ProducerError):
                 return
 
     def before_first(self):
         self._gen += 1
+        self._failed = None
         if self._thread is not None:
             self._thread.join()  # unblocks via the generation check
         self.base.before_first()
@@ -405,7 +405,13 @@ class ThreadBufferIterator(IIterator):
 
     def next(self):
         assert self._queue is not None, "call before_first() first"
-        return self._queue.get()
+        if self._failed is not None:
+            raise self._failed  # epoch is dead; rewind with before_first()
+        v = self._queue.get()
+        if isinstance(v, ProducerError):
+            self._failed = v.exc
+            raise v.exc
+        return v
 
     def close(self):
         self._gen += 1

@@ -20,6 +20,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .io.device_prefetch import DevicePrefetcher, StagedGroup, item_h2d_sec
 from .io.factory import create_iterator, init_iterator
 from .monitor import log as mlog
 from .monitor.trace import ProfileWindow
@@ -45,6 +46,14 @@ class LearnTask:
         self.silent = 0
         self.test_io = 0
         self.multi_step = 0
+        # device-side input prefetch (doc/io.md): a producer thread stages
+        # batches (stack/cast/sharded device_put/input_s2d) this many
+        # dispatches ahead of the train loop, so H2D transfer overlaps
+        # device compute.  0 = stage synchronously (still off the
+        # dispatch timer)
+        self.prefetch_device = 2
+        self._eval_prefetchers: Optional[list] = None
+        self._pred_prefetcher = None
         # diagnostic twin of test_io: test_io=1 isolates the input
         # pipeline (no device work); synth_device_data=1 isolates the
         # device loop (pre-staged on-device batches, no host transfer)
@@ -104,6 +113,8 @@ class LearnTask:
             self.test_io = int(val)
         elif name == "multi_step":
             self.multi_step = int(val)
+        elif name == "prefetch_device":
+            self.prefetch_device = int(val)
         elif name == "synth_device_data":
             self.synth_device_data = int(val)
         elif name == "extract_node_name":
@@ -313,166 +324,197 @@ class LearnTask:
         # multi_step group is one); trainer.sample_counter counts update
         # steps, which diverges from dispatches under grouping
         global_dispatch = 0
-        while self.start_counter <= self.num_round and cc > 0:
-            cc -= 1
-            mlog.info(f"update round {self.start_counter - 1}")
-            prof.maybe_start_round(rounds_done, prof_round)
-            round_t0 = time.time()
-            sample_counter = 0
-            n_round = 0
-            t_mark = time.time()
-            n_mark = 0
-            # host wall split for input-bound detection: time blocked on
-            # the iterator vs time spent dispatching steps (dispatch is
-            # async past compilation — a dispatch >> iter_wait round is
-            # device-bound; the reverse is starving on input)
-            iter_wait = dispatch_sec = 0.0
-            iter_wait_mark = dispatch_mark = 0.0
-            self.net.start_round(self.start_counter)
-            self.itr_train.before_first()
-            # multi_step > 1 groups K batches into ONE device dispatch
-            # (an on-device lax.scan), the TPU equivalent of the
-            # reference's ThreadBuffer keeping the GPU queue full
-            # (iter_batch_proc-inl.hpp:136-224); train metrics stay exact
-            # (outputs come back stacked, one D2H per group)
-            # pairtest nets stay on the per-batch path: grouped dispatch
-            # would drop their step diagnostics (reference exceedance
-            # reporting); monitored nets too (the scan path carries no
-            # per-layer norm outputs)
-            group_n = self.multi_step if (
-                self.multi_step > 1 and self.test_io == 0
-                and self.net.update_period == 1
-                and not self.net.has_diagnostics
-                and not self.net.monitor) else 1
-            pending = []
-            done = False
-            while not done:
-                t0 = time.perf_counter()
-                batch = self.itr_train.next()
-                iter_wait_mark += time.perf_counter() - t0
-                if batch is None:
-                    done = True
+        # multi_step > 1 groups K batches into ONE device dispatch
+        # (an on-device lax.scan), the TPU equivalent of the
+        # reference's ThreadBuffer keeping the GPU queue full
+        # (iter_batch_proc-inl.hpp:136-224); train metrics stay exact
+        # (outputs come back stacked, one D2H per group)
+        # pairtest nets stay on the per-batch path: grouped dispatch
+        # would drop their step diagnostics (reference exceedance
+        # reporting); monitored nets too (the scan path carries no
+        # per-layer norm outputs)
+        group_n = self.multi_step if (
+            self.multi_step > 1 and self.test_io == 0
+            and self.net.update_period == 1
+            and not self.net.has_diagnostics
+            and not self.net.monitor) else 1
+        # staged item source: grouping + np.stack + dtype cast + sharded
+        # device_put + input_s2d all happen OFF the dispatch window — on
+        # a producer thread running prefetch_device dispatches ahead
+        # (the reference's ThreadBuffer moved host decode off the
+        # critical path; this moves the H2D transfer too), or inline
+        # just before the dispatch timer when prefetch_device = 0
+        src = None if self.test_io else DevicePrefetcher(
+            self.itr_train, self.net, group_n=group_n,
+            depth=self.prefetch_device, metrics=metrics)
+        try:
+            while self.start_counter <= self.num_round and cc > 0:
+                cc -= 1
+                mlog.info(f"update round {self.start_counter - 1}")
+                prof.maybe_start_round(rounds_done, prof_round)
+                round_t0 = time.time()
+                sample_counter = 0
+                n_round = 0
+                t_mark = time.time()
+                n_mark = 0
+                # host wall split for input-bound detection: time blocked
+                # on input (the host iterator, or the staging queue when
+                # prefetching) vs time spent dispatching steps vs time
+                # staging batches onto the device (h2d; off the critical
+                # path when the producer thread runs it)
+                iter_wait = dispatch_sec = h2d_total = 0.0
+                iter_wait_mark = dispatch_mark = h2d_mark = 0.0
+                depth_sum = depth_n = 0
+                self.net.start_round(self.start_counter)
+                if src is not None:
+                    src.before_first()
                 else:
-                    pending.append(batch)
-                flush = done or len(pending) >= group_n
-                if not flush or not pending:
-                    continue
-                group, pending = pending, []
-                first_dispatch = False
-                if self.test_io == 0:
-                    prof.maybe_start_step(global_dispatch)
-                    global_dispatch += 1
-                    first_dispatch = self.compile_sec is None
+                    self.itr_train.before_first()
+                while True:
                     t0 = time.perf_counter()
-                    # extra-data inputs aren't threaded through the scan
-                    # path; fall back to per-batch dispatch for them.  A
-                    # short final batch (round_batch=0) can't be stacked
-                    # with full ones — shapes must be uniform to group
-                    uniform = all(
-                        b.data.shape == group[0].data.shape
-                        and b.label.shape == group[0].label.shape
-                        and b.tail_mask_padd == 0
-                        for b in group)
-                    if len(group) > 1 and uniform and not any(
-                            b.extra_data for b in group):
-                        self._update_group(group)
+                    first_dispatch = False
+                    if src is None:
+                        # test_io = 1: host pipeline only, no staging
+                        batch = self.itr_train.next()
+                        iter_wait_mark += time.perf_counter() - t0
+                        if batch is None:
+                            break
+                        metas = (batch,)
                     else:
-                        for b in group:
-                            self.net.update(b)
-                    dt = time.perf_counter() - t0
-                    if first_dispatch:
-                        # jit traces + compiles synchronously inside the
-                        # first dispatch: report it separately and keep it
-                        # out of the steady-state examples/sec window (the
-                        # old code silently folded it into the first one)
-                        self.compile_sec = dt
-                        metrics.emit("compile", compile_sec=round(dt, 3),
-                                     round=self.start_counter - 1)
-                        mlog.info(f"compile: {dt:.1f} sec (first dispatch, "
-                                  "excluded from examples/sec)")
-                        t_mark, n_mark = time.time(), 0
-                    else:
-                        dispatch_mark += dt
-                    if prof.after_step():
-                        mlog.info(
-                            f"profile trace written to {self.prof_dir}")
-                for b in group:
-                    sample_counter += 1
-                    n_real = b.batch_size - b.num_batch_padd
-                    n_round += n_real
-                    if not first_dispatch:
-                        n_mark += n_real
-                    if sample_counter % self.print_step == 0:
-                        now = time.time()
-                        rate = n_mark / max(now - t_mark, 1e-9)
-                        if metrics.active and self.test_io == 0:
-                            loss = getattr(self.net, "_last_loss", None)
-                            metrics.emit(
-                                "step", round=self.start_counter - 1,
-                                step=sample_counter,
-                                global_step=self.net.sample_counter,
-                                elapsed_sec=round(now - start, 3),
-                                examples_per_sec=round(rate, 1),
-                                iter_wait_sec=round(iter_wait_mark, 4),
-                                dispatch_sec=round(dispatch_mark, 4),
-                                loss=None if loss is None
-                                else float(np.asarray(loss)))
-                        t_mark, n_mark = now, 0
-                        iter_wait += iter_wait_mark
-                        dispatch_sec += dispatch_mark
-                        iter_wait_mark = dispatch_mark = 0.0
-                        mlog.info(
-                            f"round {self.start_counter - 1:8d}:"
-                            f"[{sample_counter:8d}] {int(now - start)} sec "
-                            f"elapsed, {rate:.1f} examples/sec")
-                        self._report_diagnostics()
-            if prof.round_end():
-                mlog.info(f"profile trace written to {self.prof_dir}")
-            rounds_done += 1
-            iter_wait += iter_wait_mark
-            dispatch_sec += dispatch_mark
-            train_wall = time.time() - round_t0
-            if self.test_on_server:
-                # per-round replica consistency check (the reference's
-                # test_on_server weight check, async_updater-inl.hpp:144-154)
-                drift = self.net.check_weight_consistency()
-                if drift != 0.0:
-                    raise RuntimeError(
-                        f"replica weights diverged (max abs diff {drift})")
-            round_metrics = {}
-            if self.test_io == 0:
-                line = f"[{self.start_counter}]"
-                # only print the train metric when the trainer actually
-                # accumulated it (eval_train also gates accumulation in
-                # NetTrainer.update — a 0 here would print all-zero metrics)
-                if self.eval_train:
-                    line += self.net.train_eval_line("train")
-                    round_metrics.update(
-                        self.net.train_metric.values("train"))
-                for it, name in zip(self.itr_evals, self.eval_names):
-                    line += self.net.evaluate(it, name)
-                    round_metrics.update(self.net.metric.values(name))
-                mlog.result(line)
-            if metrics.active:
-                rec = dict(round=self.start_counter,
-                           wall_sec=round(train_wall, 3),
-                           eval_sec=round(
-                               time.time() - round_t0 - train_wall, 3),
-                           examples=n_round,
-                           examples_per_sec=round(
-                               n_round / max(train_wall, 1e-9), 1),
-                           iter_wait_sec=round(iter_wait, 3),
-                           dispatch_sec=round(dispatch_sec, 3),
-                           train_step_traces=metrics.counters.get(
-                               "train_step_traces", 0),
-                           eval_step_traces=metrics.counters.get(
-                               "eval_step_traces", 0),
-                           **round_metrics)
-                if rounds_done == 1 and self.compile_sec is not None:
-                    rec["compile_sec"] = round(self.compile_sec, 3)
-                rec.update(self.net.memory_gauges())
-                metrics.emit("round", **rec)
-            self._save_model()
+                        item = src.next()
+                        wall = time.perf_counter() - t0
+                        if item is None:
+                            break
+                        if src.async_:
+                            # blocked on the staging queue; the transfer
+                            # itself ran on the producer thread (h2d_mark
+                            # tracks it leaving the critical path)
+                            iter_wait_mark += wall
+                            depth_sum += src.last_depth
+                            depth_n += 1
+                        else:
+                            iter_wait_mark += src.last_wait_sec
+                        h2d_mark += item_h2d_sec(item)
+                        prof.maybe_start_step(global_dispatch)
+                        global_dispatch += 1
+                        first_dispatch = self.compile_sec is None
+                        t0 = time.perf_counter()
+                        if isinstance(item, StagedGroup):
+                            self._update_group(item)
+                            metas = item.meta
+                        else:
+                            for sb in item:
+                                self.net.update(sb)
+                            metas = item
+                        dt = time.perf_counter() - t0
+                        if first_dispatch:
+                            # jit traces + compiles synchronously inside
+                            # the first dispatch: report it separately and
+                            # keep it out of the steady-state examples/sec
+                            # window (the old code silently folded it into
+                            # the first one)
+                            self.compile_sec = dt
+                            metrics.emit("compile", compile_sec=round(dt, 3),
+                                         round=self.start_counter - 1)
+                            mlog.info(f"compile: {dt:.1f} sec (first "
+                                      "dispatch, excluded from examples/sec)")
+                            t_mark, n_mark = time.time(), 0
+                        else:
+                            dispatch_mark += dt
+                        if prof.after_step():
+                            mlog.info(
+                                f"profile trace written to {self.prof_dir}")
+                    for b in metas:
+                        sample_counter += 1
+                        n_real = b.batch_size - b.num_batch_padd
+                        n_round += n_real
+                        if not first_dispatch:
+                            n_mark += n_real
+                        if sample_counter % self.print_step == 0:
+                            now = time.time()
+                            rate = n_mark / max(now - t_mark, 1e-9)
+                            if metrics.active and self.test_io == 0:
+                                loss = getattr(self.net, "_last_loss", None)
+                                metrics.emit(
+                                    "step", round=self.start_counter - 1,
+                                    step=sample_counter,
+                                    global_step=self.net.sample_counter,
+                                    elapsed_sec=round(now - start, 3),
+                                    examples_per_sec=round(rate, 1),
+                                    iter_wait_sec=round(iter_wait_mark, 4),
+                                    dispatch_sec=round(dispatch_mark, 4),
+                                    h2d_sec=round(h2d_mark, 4),
+                                    staging_depth=round(
+                                        depth_sum / depth_n, 2)
+                                    if depth_n else 0.0,
+                                    loss=None if loss is None
+                                    else float(np.asarray(loss)))
+                            t_mark, n_mark = now, 0
+                            iter_wait += iter_wait_mark
+                            dispatch_sec += dispatch_mark
+                            h2d_total += h2d_mark
+                            iter_wait_mark = dispatch_mark = h2d_mark = 0.0
+                            depth_sum = depth_n = 0
+                            mlog.info(
+                                f"round {self.start_counter - 1:8d}:"
+                                f"[{sample_counter:8d}] {int(now - start)} "
+                                f"sec elapsed, {rate:.1f} examples/sec")
+                            self._report_diagnostics()
+                if prof.round_end():
+                    mlog.info(f"profile trace written to {self.prof_dir}")
+                rounds_done += 1
+                iter_wait += iter_wait_mark
+                dispatch_sec += dispatch_mark
+                h2d_total += h2d_mark
+                train_wall = time.time() - round_t0
+                if self.test_on_server:
+                    # per-round replica consistency check (the reference's
+                    # test_on_server weight check,
+                    # async_updater-inl.hpp:144-154)
+                    drift = self.net.check_weight_consistency()
+                    if drift != 0.0:
+                        raise RuntimeError(
+                            f"replica weights diverged (max abs diff {drift})")
+                round_metrics = {}
+                if self.test_io == 0:
+                    line = f"[{self.start_counter}]"
+                    # only print the train metric when the trainer actually
+                    # accumulated it (eval_train also gates accumulation in
+                    # NetTrainer.update — a 0 here would print all-zero
+                    # metrics)
+                    if self.eval_train:
+                        line += self.net.train_eval_line("train")
+                        round_metrics.update(
+                            self.net.train_metric.values("train"))
+                    for it, name in zip(self._eval_sources(),
+                                        self.eval_names):
+                        line += self.net.evaluate(it, name)
+                        round_metrics.update(self.net.metric.values(name))
+                    mlog.result(line)
+                if metrics.active:
+                    rec = dict(round=self.start_counter,
+                               wall_sec=round(train_wall, 3),
+                               eval_sec=round(
+                                   time.time() - round_t0 - train_wall, 3),
+                               examples=n_round,
+                               examples_per_sec=round(
+                                   n_round / max(train_wall, 1e-9), 1),
+                               iter_wait_sec=round(iter_wait, 3),
+                               dispatch_sec=round(dispatch_sec, 3),
+                               h2d_sec=round(h2d_total, 3),
+                               train_step_traces=metrics.counters.get(
+                                   "train_step_traces", 0),
+                               eval_step_traces=metrics.counters.get(
+                                   "eval_step_traces", 0),
+                               **round_metrics)
+                    if rounds_done == 1 and self.compile_sec is not None:
+                        rec["compile_sec"] = round(self.compile_sec, 3)
+                    rec.update(self.net.memory_gauges())
+                    metrics.emit("round", **rec)
+                self._save_model()
+        finally:
+            if src is not None:
+                src.close()
         if prof.active:
             # a step-bounded window the run never filled (prof_num_steps
             # past the last dispatch, or test_io=1): flush it rather than
@@ -517,21 +559,51 @@ class LearnTask:
             self._save_model()
         mlog.info(f"\nupdating end, {int(time.time() - start)} sec in all")
 
-    def _update_group(self, group) -> None:
-        """Dispatch a group of batches as one on-device multi-step scan,
-        accumulating the train metric from the stacked eval outputs."""
+    def _update_group(self, staged: StagedGroup) -> None:
+        """Dispatch one staged multi-step group (a device-resident
+        ``(k, batch, ...)`` stack — the ``np.stack`` + cast + transfer
+        already ran off the dispatch window, on the prefetch producer
+        thread or inline via ``NetTrainer.stage_group``) as one on-device
+        scan, accumulating the train metric from the stacked eval
+        outputs."""
         net = self.net
-        datas = np.stack([b.data for b in group])
-        labels = np.stack([b.label for b in group])
         want_outs = bool(net.eval_train and net.train_metric.evals)
         if want_outs:
-            _, outs = net.update_many(datas, labels, with_outs=True)
+            _, outs = net.update_many(staged.datas, staged.labels,
+                                      with_outs=True)
             outs = {nid: np.asarray(v) for nid, v in outs.items()}
-            for j, b in enumerate(group):
+            for j, m in enumerate(staged.meta):
                 net.accumulate_train_metric(
-                    {nid: outs[nid][j] for nid in outs}, b.label)
+                    {nid: outs[nid][j] for nid in outs}, m.label)
         else:
-            net.update_many(datas, labels)
+            net.update_many(staged.datas, staged.labels)
+
+    def _eval_sources(self):
+        """Eval iterators, wrapped with device prefetchers (grouped to
+        ``eval_group``, staged ``prefetch_device`` dispatches ahead) when
+        prefetching is on; created once and reused every round."""
+        if self.prefetch_device <= 0 or self.net is None:
+            return self.itr_evals
+        if self._eval_prefetchers is None:
+            self._eval_prefetchers = [
+                DevicePrefetcher(it, self.net,
+                                 group_n=self.net.eval_group,
+                                 depth=self.prefetch_device,
+                                 metrics=self.net.metrics, for_eval=True)
+                for it in self.itr_evals]
+        return self._eval_prefetchers
+
+    def _pred_source(self):
+        """The pred iterator, staged one batch per item ahead of the
+        inference loop when prefetching is on."""
+        if self.prefetch_device <= 0 or self.itr_pred is None:
+            return self.itr_pred
+        if self._pred_prefetcher is None:
+            self._pred_prefetcher = DevicePrefetcher(
+                self.itr_pred, self.net, group_n=1,
+                depth=self.prefetch_device, metrics=self.net.metrics,
+                for_eval=True)
+        return self._pred_prefetcher
 
     def _report_diagnostics(self) -> None:
         """Print step diagnostics (pairtest fwd/bwd/weight relative errors),
@@ -556,10 +628,11 @@ class LearnTask:
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
         mlog.notice("start predicting...")
+        src = self._pred_source()
         with open(self.name_pred, "w") as fo:
-            self.itr_pred.before_first()
+            src.before_first()
             while True:
-                batch = self.itr_pred.next()
+                batch = src.next()
                 if batch is None:
                     break
                 pred = self.net.predict(batch)
@@ -574,10 +647,11 @@ class LearnTask:
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
         mlog.notice("start predicting raw scores...")
+        src = self._pred_source()
         with open(self.name_pred, "w") as fo:
-            self.itr_pred.before_first()
+            src.before_first()
             while True:
-                batch = self.itr_pred.next()
+                batch = src.next()
                 if batch is None:
                     break
                 out = self.net.predict_raw(batch)
@@ -592,11 +666,12 @@ class LearnTask:
         assert node, "must set extract_node_name"
         mlog.notice(f"start extracting feature from node {node} ...")
         binary = self.output_format == 0
+        src = self._pred_source()
         with open(self.name_pred, "wb" if binary else "w") as fo:
-            self.itr_pred.before_first()
+            src.before_first()
             wrote_meta = False
             while True:
-                batch = self.itr_pred.next()
+                batch = src.next()
                 if batch is None:
                     break
                 feat = self.net.extract_feature(batch, node)
@@ -636,6 +711,10 @@ class LearnTask:
             else:
                 raise ValueError(f"unknown task {self.task!r}")
         finally:
+            for pf in (self._eval_prefetchers or []) + \
+                    ([self._pred_prefetcher] if self._pred_prefetcher
+                     else []):
+                pf.close()  # joins producer threads; bases closed below
             for it in ([self.itr_train] if self.itr_train else []) + \
                     self.itr_evals + ([self.itr_pred] if self.itr_pred else []):
                 it.close()

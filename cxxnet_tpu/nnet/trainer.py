@@ -1213,7 +1213,82 @@ class NetTrainer:
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(
                 sharding, arr, global_shape_fn(arr))
-        return jnp.asarray(arr)
+        # committed sharded transfer: the array lands distributed per the
+        # step's in_sharding at STAGING time, so the jitted dispatch never
+        # pays a reshard/copy (prefetch-to-device needs the whole transfer
+        # off the dispatch window, not just the host->device-0 leg)
+        return jax.device_put(arr, sharding)
+
+    # -------------------------------------------------------------- staging
+    def stage_batch(self, batch) -> "StagedBatch":
+        """Host DataBatch -> device-resident :class:`StagedBatch`: dtype
+        cast, sharded transfer, the ``input_s2d`` staging transform, and
+        the tail loss mask — everything ``update``/``predict`` would
+        otherwise do inside the dispatch window.  Blocks until the
+        transfer completes, so a queue of staged batches is truly
+        device-resident (call off the hot path — the
+        :class:`~cxxnet_tpu.io.device_prefetch.DevicePrefetcher` producer
+        thread does)."""
+        from ..io.device_prefetch import StagedBatch
+        t0 = time.perf_counter()
+        data = self._s2d_transform(self._device_batch(batch.data))
+        label = self._device_batch(batch.label, jnp.float32)
+        extras = tuple(self._device_batch(e) for e in batch.extra_data)
+        n_padd = int(getattr(batch, "tail_mask_padd", 0))
+        mask = None
+        if n_padd:
+            host_mask = np.ones((batch.batch_size,), np.float32)
+            host_mask[batch.batch_size - n_padd:] = 0.0
+            mask = self._device_batch(host_mask)
+        jax.block_until_ready((data, label, extras)
+                              if mask is None else (data, label, extras,
+                                                    mask))
+        return StagedBatch(
+            data=data, label=label, label_host=np.asarray(batch.label),
+            index=batch.index, num_batch_padd=batch.num_batch_padd,
+            tail_mask_padd=n_padd, extra_data=extras, mask=mask,
+            h2d_sec=time.perf_counter() - t0)
+
+    def stage_group(self, group) -> "StagedGroup":
+        """Uniform host batches (no tail masks, no extra-data) -> one
+        device-resident ``(k, batch, ...)`` stack for
+        :meth:`update_many` — the group ``np.stack`` + cast + transfer
+        off the dispatch window."""
+        from ..io.device_prefetch import StagedGroup, StagedMeta
+        t0 = time.perf_counter()
+        datas = self._s2d_transform(
+            self._device_stacked(np.stack([b.data for b in group])),
+            stacked=True)
+        labels = self._device_stacked(
+            np.stack([b.label for b in group]), jnp.float32)
+        jax.block_until_ready((datas, labels))
+        return StagedGroup(
+            datas=datas, labels=labels,
+            meta=[StagedMeta(batch_size=b.batch_size,
+                             num_batch_padd=b.num_batch_padd,
+                             tail_mask_padd=b.tail_mask_padd,
+                             label=np.asarray(b.label), index=b.index)
+                  for b in group],
+            h2d_sec=time.perf_counter() - t0)
+
+    def stage_eval_group(self, group) -> "StagedEvalGroup":
+        """Eval batches -> one device-resident ``(k, batch, ...)`` stack
+        for the scanned eval step (labels stay host-side — the metric
+        consumes them there)."""
+        from ..io.device_prefetch import StagedEvalGroup, StagedMeta
+        t0 = time.perf_counter()
+        datas = self._s2d_transform(
+            self._device_stacked(np.stack([b.data for b in group])),
+            stacked=True)
+        jax.block_until_ready(datas)
+        return StagedEvalGroup(
+            datas=datas,
+            meta=[StagedMeta(batch_size=b.batch_size,
+                             num_batch_padd=b.num_batch_padd,
+                             tail_mask_padd=b.tail_mask_padd,
+                             label=np.asarray(b.label), index=b.index)
+                  for b in group],
+            h2d_sec=time.perf_counter() - t0)
 
     def _grad_acc_init(self):
         return jax.tree.map(jnp.zeros_like, self.params)
@@ -1244,10 +1319,14 @@ class NetTrainer:
         if n_padd:
             # masked-step variant, compiled lazily (once per trainer): only
             # the epoch's padded tail batch takes this path, so the common
-            # step never carries mask operands or masked-statistics code
-            host_mask = np.ones((batch.data.shape[0],), np.float32)
-            host_mask[batch.data.shape[0] - n_padd:] = 0.0
-            maskarg = (self._device_batch(host_mask),)
+            # step never carries mask operands or masked-statistics code.
+            # A StagedBatch arrives with the mask already device-resident
+            mask = getattr(batch, "mask", None)
+            if mask is None:
+                host_mask = np.ones((batch.data.shape[0],), np.float32)
+                host_mask[batch.data.shape[0] - n_padd:] = 0.0
+                mask = self._device_batch(host_mask)
+            maskarg = (mask,)
             if getattr(self, "_train_step_masked", None) is None:
                 self._train_step_masked = self._build_train_step(
                     with_mask=True)
@@ -1278,7 +1357,9 @@ class NetTrainer:
                 and self.sample_counter % self.monitor_interval == 0:
             self._monitor_tick(loss, self._last_monitor)
         if self.eval_train and self.train_metric.evals:
-            self.accumulate_train_metric(outs, batch.label, n_padd=n_padd)
+            self.accumulate_train_metric(
+                outs, getattr(batch, "label_host", batch.label),
+                n_padd=n_padd)
 
     def _monitor_tick(self, loss, mon) -> None:
         """Materialize one monitored step on the host: the NaN/inf loss
@@ -1337,7 +1418,23 @@ class NetTrainer:
         return any(isinstance(c.layer, PairTestLayer)
                    for c in self.net.connections)
 
+    def _eval_accumulate(self, meta, outs_row) -> None:
+        """Add one batch's eval outputs (padding excluded) to the
+        metric; ``meta`` is anything with batch_size/num_batch_padd/
+        label (host)."""
+        n_valid = meta.batch_size - meta.num_batch_padd
+        preds = [outs_row[nid][:n_valid] for nid in self.eval_node_ids]
+        labels = {fname: np.asarray(meta.label)[:n_valid, a:b_]
+                  for fname, a, b_ in self._label_fields}
+        self.metric.add_eval(preds, labels)
+
     def evaluate(self, data_iter, name: str) -> str:
+        """Evaluate one pass of ``data_iter`` — raw ``DataBatch``es
+        (grouped + staged here, the legacy path) or pre-staged items from
+        a :class:`~cxxnet_tpu.io.device_prefetch.DevicePrefetcher`
+        (device-resident before dispatch)."""
+        from ..io.device_prefetch import (StagedBatch, StagedEvalGroup,
+                                          StagedMeta)
         self.metric.clear()
         node_ids = tuple(dict.fromkeys(self.eval_node_ids))
         group: List[DataBatch] = []
@@ -1371,6 +1468,28 @@ class NetTrainer:
             group.clear()
 
         for batch in data_iter:
+            if isinstance(batch, StagedEvalGroup):
+                flush()
+                fn = self._build_eval_many(len(batch.meta), node_ids)
+                outs = jax.tree.map(
+                    np.asarray, fn(self.params, self.buffers, batch.datas))
+                for i, m in enumerate(batch.meta):
+                    self._eval_accumulate(
+                        m, {nid: outs[nid][i] for nid in node_ids})
+                continue
+            if isinstance(batch, StagedBatch):
+                flush()
+                estep = self._get_eval_step(node_ids)
+                outs = estep(self.params, self.buffers, batch.data,
+                             batch.extra_data)
+                outs = {nid: np.asarray(v) for nid, v in outs.items()}
+                self._eval_accumulate(
+                    StagedMeta(batch_size=batch.batch_size,
+                               num_batch_padd=batch.num_batch_padd,
+                               tail_mask_padd=batch.tail_mask_padd,
+                               label=batch.label_host, index=batch.index),
+                    outs)
+                continue
             if batch.extra_data:
                 # extra-data side inputs keep the per-batch path
                 flush()

@@ -18,6 +18,22 @@ def test_bench_imports_and_flop_count():
     assert 1.2e9 < fwd < 1.7e9, fwd
 
 
+def test_bench_io_ab_mode():
+    """--io-ab payload: batches/sec with prefetch on vs off plus the
+    h2d / iter-wait accounting, on the CPU backend."""
+    import bench
+    payload = bench.bench_io_ab(
+        ["dev=cpu", "batch_size=32", "n_inst=256", "num_round=2"])
+    assert payload["metric"] == "io_ab_batches_per_sec"
+    assert payload["value"] == payload["batches_per_sec_on"] > 0
+    assert payload["batches_per_sec_off"] > 0
+    assert payload["vs_prefetch_off"] > 0
+    for tag in ("on", "off"):
+        assert payload[f"h2d_sec_{tag}"] >= 0
+        assert 0 <= payload[f"iter_wait_share_{tag}"] <= 1.5
+        assert payload[f"dispatch_share_{tag}"] >= 0
+
+
 def test_bench_baseline_json_shape():
     """The driver parses one JSON object with these exact keys."""
     import json

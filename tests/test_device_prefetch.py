@@ -1,0 +1,290 @@
+"""Device-side input prefetch (io/device_prefetch.py): bitwise parity of
+prefetch-on vs prefetch-off training, device-residency of staged inputs
+(zero device_put inside the dispatch window), h2d/staging-depth telemetry,
+producer-exception propagation, and thread hygiene."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax  # noqa: E402
+
+from cxxnet_tpu.io.data import DataBatch, IIterator  # noqa: E402
+from cxxnet_tpu.io.device_prefetch import DevicePrefetcher  # noqa: E402
+from cxxnet_tpu.main import LearnTask  # noqa: E402
+from cxxnet_tpu.nnet.trainer import NetTrainer  # noqa: E402
+from cxxnet_tpu.utils import serializer  # noqa: E402
+
+from test_main import MLP_NET, _write_synth_mnist  # noqa: E402
+
+
+# --------------------------------------------------------------- CLI parity
+
+def _write_conf(tmp_path, n, extra_cfg, sink):
+    _write_synth_mnist(tmp_path, n=n)
+    conf = tmp_path / f"train_{len(extra_cfg)}.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 1
+iter = end
+eval = val
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+eta = 0.05
+num_round = 3
+metric = error
+print_step = 1
+silent = 1
+metrics_sink = jsonl:{sink}
+{extra_cfg}
+""")
+    return conf
+
+
+def _train_once(tmp_path, n, extra_cfg, tag, prefetch):
+    sink = tmp_path / f"metrics_{tag}_{prefetch}.jsonl"
+    model_dir = tmp_path / f"models_{tag}_{prefetch}"
+    conf = _write_conf(tmp_path, n, extra_cfg, sink)
+    task = LearnTask()
+    assert task.run([str(conf), f"prefetch_device={prefetch}",
+                     f"model_dir={model_dir}", "save_model=3"]) == 0
+    recs = [json.loads(l) for l in open(sink)]
+    losses = [r["loss"] for r in recs if r["kind"] == "step"]
+    rounds = [r for r in recs if r["kind"] == "round"]
+    _, params, _, _ = serializer.load_model(str(model_dir / "0003.model"))
+    return losses, rounds, params
+
+
+# tail masking (40 = 2 full + masked tail of 8), round_batch wrap,
+# multi_step grouping, and gradient accumulation — the four paths whose
+# staging differs (ISSUE 3 satellite: prefetch correctness coverage)
+@pytest.mark.parametrize("tag,n,extra_cfg", [
+    ("tail", 40, ""),
+    ("roundb", 40, "round_batch = 1"),
+    ("mstep", 64, "multi_step = 2"),
+    ("uperiod", 64, "update_period = 2"),
+])
+def test_prefetch_on_off_bitwise_identical(tmp_path, tag, n, extra_cfg):
+    off = _train_once(tmp_path, n, extra_cfg, tag, prefetch=0)
+    on = _train_once(tmp_path, n, extra_cfg, tag, prefetch=2)
+    assert len(off[0]) == len(on[0]) and len(off[0]) > 0
+    assert off[0] == on[0], "per-step losses must be bitwise identical"
+    # eval ran through the prefetcher in the 'on' run: same metrics
+    for r_off, r_on in zip(off[1], on[1]):
+        assert r_off["val-error"] == r_on["val-error"]
+        assert r_off["train-error"] == r_on["train-error"]
+    flat_off = jax.tree.leaves(off[2])
+    flat_on = jax.tree.leaves(on[2])
+    assert len(flat_off) == len(flat_on)
+    for a, b in zip(flat_off, flat_on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pred_raw_prefetch_matches(tmp_path):
+    """task=pred_raw through the staged inference path gives the same
+    scores file as the unprefetched loop."""
+    sink = tmp_path / "m.jsonl"
+    conf = _write_conf(tmp_path, 40, "", sink)
+    task = LearnTask()
+    assert task.run([str(conf), f"model_dir={tmp_path}/models",
+                     "save_model=3"]) == 0
+    pred_conf = tmp_path / "pred.conf"
+    pred_conf.write_text(f"""
+dev = cpu
+task = pred_raw
+model_in = {tmp_path}/models/0003.model
+pred = {tmp_path}/scores.txt
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+silent = 1
+""")
+    outs = []
+    for pf in (0, 2):
+        out = tmp_path / f"scores_{pf}.txt"
+        assert LearnTask().run([str(pred_conf), f"prefetch_device={pf}",
+                                f"pred={out}"]) == 0
+        outs.append(out.read_text())
+    assert outs[0] == outs[1]
+
+
+# ----------------------------------------------- device residency + records
+
+def _spy_trainer(monkeypatch, state):
+    """Count host->device conversions performed by the dispatch thread
+    INSIDE update/update_many, and assert staged inputs arrive as
+    jax.Arrays.  The producer thread stages concurrently by design, so
+    only calls from the thread that entered the dispatch count."""
+    orig_put = NetTrainer._device_put
+    orig_update = NetTrainer.update
+    orig_many = NetTrainer.update_many
+
+    def spy_put(self, arr, dtype, sharding, global_shape_fn):
+        host_input = not (isinstance(arr, jax.Array)
+                          and not isinstance(arr, np.ndarray))
+        if host_input and \
+                threading.get_ident() == state.get("dispatch_thread"):
+            state["violations"] += 1
+        return orig_put(self, arr, dtype, sharding, global_shape_fn)
+
+    def spy_update(self, batch):
+        assert isinstance(batch.data, jax.Array)
+        assert isinstance(batch.label, jax.Array)
+        assert all(isinstance(e, jax.Array) for e in batch.extra_data)
+        state["updates"] += 1
+        state["dispatch_thread"] = threading.get_ident()
+        try:
+            return orig_update(self, batch)
+        finally:
+            state["dispatch_thread"] = None
+
+    def spy_many(self, datas, labels, with_outs=False):
+        assert isinstance(datas, jax.Array)
+        assert isinstance(labels, jax.Array)
+        state["update_manys"] += 1
+        state["dispatch_thread"] = threading.get_ident()
+        try:
+            return orig_many(self, datas, labels, with_outs)
+        finally:
+            state["dispatch_thread"] = None
+
+    monkeypatch.setattr(NetTrainer, "_device_put", spy_put)
+    monkeypatch.setattr(NetTrainer, "update", spy_update)
+    monkeypatch.setattr(NetTrainer, "update_many", spy_many)
+
+
+@pytest.mark.parametrize("extra_cfg,expect", [
+    ("", "updates"),                    # per-batch path (incl. masked tail)
+    ("multi_step = 2", "update_manys"),  # grouped scan path
+])
+def test_staged_inputs_device_resident_zero_h2d_in_dispatch(
+        tmp_path, monkeypatch, extra_cfg, expect):
+    state = {"violations": 0, "updates": 0, "update_manys": 0,
+             "dispatch_thread": None}
+    _spy_trainer(monkeypatch, state)
+    sink = tmp_path / "m.jsonl"
+    conf = _write_conf(tmp_path, 40, extra_cfg, sink)
+    assert LearnTask().run([str(conf), "save_model=0",
+                            "prefetch_device=2"]) == 0
+    assert state[expect] > 0
+    assert state["violations"] == 0, (
+        "device_put of host data ran inside the dispatch window")
+    steps = [json.loads(l) for l in open(sink)]
+    steps = [r for r in steps if r["kind"] == "step"]
+    assert steps and all("h2d_sec" in r and "staging_depth" in r
+                         and "dispatch_sec" in r for r in steps)
+    # transfers happened — on the producer thread, reported separately
+    assert sum(r["h2d_sec"] for r in steps) > 0
+
+
+def test_round_record_carries_h2d(tmp_path):
+    sink = tmp_path / "m.jsonl"
+    conf = _write_conf(tmp_path, 40, "", sink)
+    assert LearnTask().run([str(conf), "save_model=0"]) == 0
+    rounds = [json.loads(l) for l in open(sink)]
+    rounds = [r for r in rounds if r["kind"] == "round"]
+    assert rounds and all("h2d_sec" in r for r in rounds)
+
+
+# ------------------------------------------------- prefetcher unit behavior
+
+class _ListBatchIter(IIterator):
+    """Assembled-batch iterator over given arrays, optionally raising
+    after ``fail_after`` batches."""
+
+    def __init__(self, nbatch=4, fail_after=None):
+        rnd = np.random.RandomState(0)
+        self.batches = [
+            DataBatch(data=rnd.rand(4, 1, 4, 4).astype(np.float32),
+                      label=np.zeros((4, 1), np.float32),
+                      index=np.arange(4, dtype=np.uint32))
+            for _ in range(nbatch)]
+        self.fail_after = fail_after
+        self.pos = 0
+
+    def before_first(self):
+        self.pos = 0
+
+    def next(self):
+        if self.fail_after is not None and self.pos >= self.fail_after:
+            raise RuntimeError("host decode failed")
+        if self.pos >= len(self.batches):
+            return None
+        self.pos += 1
+        return self.batches[self.pos - 1]
+
+
+class _FakeStager:
+    """Stager stub: staging identity, no device work (unit tests only
+    exercise the queue/thread protocol)."""
+
+    def stage_batch(self, b):
+        b.h2d_sec = 0.0
+        return b
+
+    def stage_group(self, group):  # pragma: no cover - group_n=1 in tests
+        raise AssertionError("not used")
+
+    stage_eval_group = stage_group
+
+
+def test_prefetcher_producer_exception_propagates():
+    pf = DevicePrefetcher(_ListBatchIter(fail_after=2), _FakeStager(),
+                          group_n=1, depth=2)
+    pf.before_first()
+    assert pf.next() is not None
+    assert pf.next() is not None
+    with pytest.raises(RuntimeError, match="host decode failed"):
+        pf.next()
+    with pytest.raises(RuntimeError):
+        pf.next()  # the epoch stays dead — re-raise, never a hang
+    pf.close()
+
+
+def test_prefetcher_sync_mode_exception_propagates():
+    pf = DevicePrefetcher(_ListBatchIter(fail_after=1), _FakeStager(),
+                          group_n=1, depth=0)
+    pf.before_first()
+    assert pf.next() is not None
+    with pytest.raises(RuntimeError, match="host decode failed"):
+        pf.next()
+    with pytest.raises(RuntimeError):
+        pf.next()  # latched like async mode — never a silent clean end
+    pf.close()
+
+
+def test_prefetcher_thread_hygiene_across_epochs():
+    """threading.active_count() returns to baseline after close(), with
+    no per-epoch thread accumulation across before_first() cycles."""
+    baseline = threading.active_count()
+    pf = DevicePrefetcher(_ListBatchIter(nbatch=6), _FakeStager(),
+                          group_n=1, depth=2)
+    for _ in range(5):
+        pf.before_first()
+        n = 0
+        while pf.next() is not None:
+            n += 1
+        assert n == 6
+        # one producer at most (may already have exited after the epoch)
+        assert threading.active_count() <= baseline + 1
+    pf.close()
+    assert threading.active_count() == baseline

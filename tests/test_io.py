@@ -309,6 +309,63 @@ def test_threadbuffer_rapid_rewind_stress():
     assert n == 6
 
 
+def test_threadbuffer_producer_exception_propagates():
+    """Regression: a raise in base.next() used to kill the producer
+    thread silently, leaving the consumer blocked forever on queue.get();
+    the exception is now enqueued and re-raised in next()."""
+    import threading
+
+    class FailingIter(IIterator):
+        def __init__(self, fail_after):
+            self.fail_after = fail_after
+            self.i = 0
+
+        def before_first(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= self.fail_after:
+                raise ValueError("corrupt record")
+            self.i += 1
+            return self.i
+
+    baseline_threads = threading.active_count()
+    it = ThreadBufferIterator(FailingIter(2))
+    it.init()
+    assert it.next() == 1
+    assert it.next() == 2
+    with pytest.raises(ValueError, match="corrupt record"):
+        it.next()
+    with pytest.raises(ValueError):
+        it.next()  # epoch stays dead — re-raise, never a hang
+    # the failed producer exited; a rewind starts a fresh epoch
+    it.before_first()
+    assert it.next() == 1
+    it.close()
+    assert threading.active_count() == baseline_threads
+
+
+def test_threadbuffer_thread_hygiene_across_epochs():
+    """No producer-thread accumulation across repeated epochs: one live
+    producer at most, and active_count() back to baseline after close()."""
+    import threading
+    baseline = threading.active_count()
+    data, labels = make_insts(12)
+    base = BatchAdaptIterator(ListInstIterator(data, labels))
+    base.set_param("batch_size", "4")
+    it = ThreadBufferIterator(base)
+    it.init()
+    for _ in range(6):
+        it.before_first()
+        n = 0
+        while it.next() is not None:
+            n += 1
+        assert n == 3
+        assert threading.active_count() <= baseline + 1
+    it.close()
+    assert threading.active_count() == baseline
+
+
 def test_imbin_decode_pool_rewind_stress(tmp_path):
     """Decode-pool iterator under rapid rewinds: stale futures from
     abandoned epochs never corrupt the restarted stream."""
