@@ -66,6 +66,26 @@ one mid-run does not retrace already-compiled steps.
 |             |                            | ~47.5 ms convert_reduce line). |
 |             |                            | Opt-in until a TPU session     |
 |             |                            | A/Bs it                        |
+| dp_overlap  | 0 (default), 1             | explicit shard_map DP step:    |
+|             |                            | gradients reduced in size-     |
+|             |                            | targeted buckets, each psum    |
+|             |                            | issued at its bucket's grad-   |
+|             |                            | ready point inside backward    |
+|             |                            | (the async_updater schedule) — |
+|             |                            | see doc/multichip.md           |
+| dp_bucket_mb| 4 (default), any float     | bucket size target in MiB      |
+|             |                            | (reverse layer order)          |
+| dp_reduce_dtype | f32 (default), bf16    | bf16 = cast grads to bf16 for  |
+|             |                            | the cross-chip reduce, f32     |
+|             |                            | master apply (halves comm;     |
+|             |                            | trajectories shift)            |
+| dp_reduce_at| apply (default), step      | with update_period > 1: reduce |
+|             |                            | the accumulated grads once per |
+|             |                            | APPLY (1/update_period the     |
+|             |                            | comm; reassociates the cross-  |
+|             |                            | chip sum) or every micro-step  |
+|             |                            | (bitwise-matches the implicit  |
+|             |                            | path)                          |
 
 ``opts`` is a PROCESS-GLOBAL singleton: every trainer in the process
 reads it at trace time, so two trainers with different lowering options
@@ -82,8 +102,19 @@ from __future__ import annotations
 
 import os
 
+def _is_positive_float(val: str) -> bool:
+    try:
+        return float(val) > 0.0
+    except ValueError:
+        return False
+
+
+_is_positive_float.expected = "a positive float"
+
+
 _DEFS = {
-    # name: (env var, default, valid values); flash_attn's env var is an
+    # name: (env var, default, valid values — a tuple of spellings or a
+    # predicate for free-form numerics); flash_attn's env var is an
     # inverted bool, special-cased in _Options.__init__
     "pool_bwd": ("CXXNET_POOL_BWD", "sas", ("sas", "eq", "gather", "auto")),
     "pool_layout": ("CXXNET_POOL_LAYOUT", "nchw", ("nchw", "chwn", "hwcn")),
@@ -100,7 +131,27 @@ _DEFS = {
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),
     "pallas_ln": ("CXXNET_PALLAS_LN", "1", ("1", "x", "0")),
     "fused_update": ("CXXNET_FUSED_UPDATE", "0", ("1", "0")),
+    # data-parallel bucketed backward-overlapped gradient reduction
+    # (parallel/overlap.py, doc/multichip.md)
+    "dp_overlap": ("CXXNET_DP_OVERLAP", "0", ("1", "0")),
+    "dp_bucket_mb": ("CXXNET_DP_BUCKET_MB", "4", _is_positive_float),
+    "dp_reduce_dtype": ("CXXNET_DP_REDUCE_DTYPE", "f32", ("f32", "bf16")),
+    "dp_reduce_at": ("CXXNET_DP_REDUCE_AT", "apply", ("apply", "step")),
 }
+
+
+def _valid(name: str, val: str) -> bool:
+    valid = _DEFS[name][2]
+    return valid(val) if callable(valid) else val in valid
+
+
+def _expectation(name: str) -> str:
+    """Human-readable constraint for error messages (a predicate's repr
+    would print a function address)."""
+    valid = _DEFS[name][2]
+    if callable(valid):
+        return getattr(valid, "expected", valid.__name__)
+    return f"one of {valid}"
 
 
 class _Options:
@@ -111,15 +162,14 @@ class _Options:
                 val = "0" if os.environ.get(env) else "1"
             else:
                 val = os.environ.get(env, default)
-            assert val in valid, (
-                f"env {env} = {val}: expected one of {valid}")
+            assert _valid(name, val), (
+                f"env {env} = {val}: expected {_expectation(name)}")
             setattr(self, name, val)
 
     def set(self, name: str, val: str) -> None:
         assert name in _DEFS, f"unknown engine option {name}"
-        valid = _DEFS[name][2]
-        assert val in valid, (
-            f"engine option {name} = {val}: expected one of {valid}")
+        assert _valid(name, val), (
+            f"engine option {name} = {val}: expected {_expectation(name)}")
         setattr(self, name, val)
 
 

@@ -285,6 +285,39 @@ class LearnTask:
             return it
         return S2DEmitIterator(it, s2d_args)
 
+    def _close_prefetchers(self) -> None:
+        """Join every device-prefetch producer thread (train src is owned
+        by task_train's own finally).  Idempotent — the task methods call
+        it from their finally blocks so a mid-round exception
+        (TrainingDiverged from ``monitor_nan = fatal``, an iterator
+        error) can't leak staging threads past the task, and run() keeps
+        it as a backstop for direct task_*() callers."""
+        for pf in (self._eval_prefetchers or []) + \
+                ([self._pred_prefetcher] if self._pred_prefetcher else []):
+            pf.close()
+        self._eval_prefetchers = None
+        self._pred_prefetcher = None
+
+    def _emit_trace_report(self, prof: ProfileWindow) -> None:
+        """Comm/compute attribution of a closed profile window: per-step
+        ``comm_sec`` / ``overlap_frac`` gauges plus a ``trace`` record
+        (doc/monitor.md) — the measured collective time the dp_overlap
+        schedule is judged on.  Parse failures must never kill training."""
+        metrics = self.net.metrics if self.net else None
+        if metrics is None:
+            return
+        try:
+            from .monitor.trace import comm_report
+            rep = comm_report(self.prof_dir,
+                              steps=max(prof.steps_traced, 1))
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            mlog.warn(f"trace summary of {self.prof_dir} failed: {e}")
+            return
+        metrics.set_gauge("comm_sec", rep["comm_sec"])
+        metrics.set_gauge("overlap_frac", rep["overlap_frac"])
+        if metrics.active:
+            metrics.emit("trace", round=self.start_counter - 1, **rep)
+
     # ---------------------------------------------------------------- tasks
     def _save_model(self) -> None:
         counter = self.start_counter
@@ -424,6 +457,7 @@ class LearnTask:
                         if prof.after_step():
                             mlog.info(
                                 f"profile trace written to {self.prof_dir}")
+                            self._emit_trace_report(prof)
                     for b in metas:
                         sample_counter += 1
                         n_real = b.batch_size - b.num_batch_padd
@@ -462,6 +496,7 @@ class LearnTask:
                             self._report_diagnostics()
                 if prof.round_end():
                     mlog.info(f"profile trace written to {self.prof_dir}")
+                    self._emit_trace_report(prof)
                 rounds_done += 1
                 iter_wait += iter_wait_mark
                 dispatch_sec += dispatch_mark
@@ -513,8 +548,12 @@ class LearnTask:
                     metrics.emit("round", **rec)
                 self._save_model()
         finally:
+            # producer threads must not outlive the task — a mid-round
+            # raise (TrainingDiverged, iterator failure) joins the train
+            # src AND the per-eval prefetchers here, not at process exit
             if src is not None:
                 src.close()
+            self._close_prefetchers()
         if prof.active:
             # a step-bounded window the run never filled (prof_num_steps
             # past the last dispatch, or test_io=1): flush it rather than
@@ -522,6 +561,7 @@ class LearnTask:
             prof.stop()
             mlog.info(f"profile trace written to {self.prof_dir} "
                       "(window truncated at training end)")
+            self._emit_trace_report(prof)
         mlog.info(f"\nupdating end, {int(time.time() - start)} sec in all")
 
     def _train_synth_device(self) -> None:
@@ -629,15 +669,18 @@ class LearnTask:
             "must specify a pred iterator to generate predictions"
         mlog.notice("start predicting...")
         src = self._pred_source()
-        with open(self.name_pred, "w") as fo:
-            src.before_first()
-            while True:
-                batch = src.next()
-                if batch is None:
-                    break
-                pred = self.net.predict(batch)
-                for v in pred:
-                    fo.write(f"{v:g}\n")
+        try:
+            with open(self.name_pred, "w") as fo:
+                src.before_first()
+                while True:
+                    batch = src.next()
+                    if batch is None:
+                        break
+                    pred = self.net.predict(batch)
+                    for v in pred:
+                        fo.write(f"{v:g}\n")
+        finally:
+            self._close_prefetchers()
         mlog.notice(f"finished prediction, write into {self.name_pred}")
 
     def task_predict_raw(self) -> None:
@@ -648,15 +691,18 @@ class LearnTask:
             "must specify a pred iterator to generate predictions"
         mlog.notice("start predicting raw scores...")
         src = self._pred_source()
-        with open(self.name_pred, "w") as fo:
-            src.before_first()
-            while True:
-                batch = src.next()
-                if batch is None:
-                    break
-                out = self.net.predict_raw(batch)
-                for row in out:
-                    fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+        try:
+            with open(self.name_pred, "w") as fo:
+                src.before_first()
+                while True:
+                    batch = src.next()
+                    if batch is None:
+                        break
+                    out = self.net.predict_raw(batch)
+                    for row in out:
+                        fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+        finally:
+            self._close_prefetchers()
         mlog.notice(f"finished prediction, write into {self.name_pred}")
 
     def task_extract(self) -> None:
@@ -667,26 +713,29 @@ class LearnTask:
         mlog.notice(f"start extracting feature from node {node} ...")
         binary = self.output_format == 0
         src = self._pred_source()
-        with open(self.name_pred, "wb" if binary else "w") as fo:
-            src.before_first()
-            wrote_meta = False
-            while True:
-                batch = src.next()
-                if batch is None:
-                    break
-                feat = self.net.extract_feature(batch, node)
-                if not wrote_meta:
-                    with open(self.name_pred + ".meta", "w") as fm:
-                        fm.write(f"{feat.shape[1]}\n")
-                    wrote_meta = True
-                if binary:
-                    # raw little-endian float32 rows (reference
-                    # cxxnet_main.cpp:316 fwrite path)
-                    fo.write(np.ascontiguousarray(
-                        feat, dtype="<f4").tobytes())
-                else:
-                    for row in feat:
-                        fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+        try:
+            with open(self.name_pred, "wb" if binary else "w") as fo:
+                src.before_first()
+                wrote_meta = False
+                while True:
+                    batch = src.next()
+                    if batch is None:
+                        break
+                    feat = self.net.extract_feature(batch, node)
+                    if not wrote_meta:
+                        with open(self.name_pred + ".meta", "w") as fm:
+                            fm.write(f"{feat.shape[1]}\n")
+                        wrote_meta = True
+                    if binary:
+                        # raw little-endian float32 rows (reference
+                        # cxxnet_main.cpp:316 fwrite path)
+                        fo.write(np.ascontiguousarray(
+                            feat, dtype="<f4").tobytes())
+                    else:
+                        for row in feat:
+                            fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+        finally:
+            self._close_prefetchers()
         mlog.notice(f"finished extraction, write into {self.name_pred}")
 
     def run(self, argv: List[str]) -> int:
@@ -711,10 +760,7 @@ class LearnTask:
             else:
                 raise ValueError(f"unknown task {self.task!r}")
         finally:
-            for pf in (self._eval_prefetchers or []) + \
-                    ([self._pred_prefetcher] if self._pred_prefetcher
-                     else []):
-                pf.close()  # joins producer threads; bases closed below
+            self._close_prefetchers()  # backstop; tasks close their own
             for it in ([self.itr_train] if self.itr_train else []) + \
                     self.itr_evals + ([self.itr_pred] if self.itr_pred else []):
                 it.close()

@@ -11,8 +11,15 @@ durations.  No tensorflow import — the bench container has TF, the test
 container might not, and a 600 MB dependency for four varint fields is
 the wrong trade.  Field numbers verified against the installed proto:
 XSpace.planes=1; XPlane.name=2/lines=3/event_metadata=4 (map: key=1,
-value=2); XLine.name=2/events=4; XEvent.metadata_id=1/duration_ps=3;
-XEventMetadata.id=1/name=2.
+value=2); XLine.name=2/events=4; XEvent.metadata_id=1/offset_ps=2/
+duration_ps=3; XEventMetadata.id=1/name=2.
+
+Collective classification: cross-chip reduction ops (all-reduce /
+reduce-scatter / all-gather / all-to-all / collective-permute, plus
+their async ``-start``/``-done`` halves) get a dedicated comm bucket
+instead of lumping with fusions — the comm column in
+tools/trace_summary.py, the bench ``--dp-scaling`` comm/compute split,
+and the ``comm_sec``/``overlap_frac`` gauges all read through it.
 """
 
 from __future__ import annotations
@@ -67,11 +74,13 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
 # ----------------------------------------------------------------- xplane
 
 class XEvent:
-    __slots__ = ("metadata_id", "duration_ps")
+    __slots__ = ("metadata_id", "duration_ps", "offset_ps")
 
-    def __init__(self, metadata_id: int, duration_ps: int):
+    def __init__(self, metadata_id: int, duration_ps: int,
+                 offset_ps: int = 0):
         self.metadata_id = metadata_id
         self.duration_ps = duration_ps
+        self.offset_ps = offset_ps
 
 
 class XLine:
@@ -93,13 +102,15 @@ class XPlane:
 
 
 def _parse_event(buf: bytes) -> XEvent:
-    mid = dur = 0
+    mid = dur = off = 0
     for field, _, val in _fields(buf):
         if field == 1:
             mid = val
+        elif field == 2:
+            off = val
         elif field == 3:
             dur = val
-    return XEvent(mid, dur)
+    return XEvent(mid, dur, off)
 
 
 def _parse_line(buf: bytes) -> XLine:
@@ -216,6 +227,129 @@ def top_ops(path: str, k: int = 10, plane_filter: str = "TPU",
     return ranked[:k]
 
 
+# ------------------------------------------------------------- collectives
+
+#: cross-chip collective op families (XLA HLO opcode spellings)
+COLLECTIVE_KINDS = frozenset((
+    "all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+    "collective-permute", "collective-broadcast",
+))
+
+
+def collective_kind(op_name: str) -> Optional[Tuple[str, str]]:
+    """``(kind, phase)`` for collective ops, ``None`` for everything
+    else.  ``phase`` is ``"start"``/``"done"`` for the async halves,
+    ``"sync"`` otherwise.
+
+    Classifies on the BASE opcode (the text before the first ``.``),
+    never by substring over the full name: the round-5 trace parser
+    matched "copy-done" against whole event strings and counted every
+    fusion CONSUMING an async copy as a copy (BASELINE.md round 5); the
+    same bug here would book a fusion named ``loop-all-reduce-fusion.3``
+    as communication.
+    """
+    base = op_name.lstrip("%").split(".", 1)[0]
+    for suffix, phase in (("-start", "start"), ("-done", "done")):
+        if base.endswith(suffix):
+            kind = base[: -len(suffix)]
+            return (kind, phase) if kind in COLLECTIVE_KINDS else None
+    return (base, "sync") if base in COLLECTIVE_KINDS else None
+
+
+def comm_summary_in(planes: List[XPlane], plane_filter: str = "TPU",
+                    line_filter: str = "XLA Ops") -> Dict[str, object]:
+    """Trace-attributed collective time.
+
+    Async ``-start``/``-done`` halves are PAIRED (FIFO per kind within a
+    line — starts and dones interleave in program order) and counted
+    once: the pair's wall is its in-flight span
+    ``done.end - start.offset`` (communication rides behind whatever
+    compute executes between the halves), its EXPOSED time is the done
+    op's duration (the wait the device actually ate).  Sync collectives
+    are fully exposed.  ``overlap_frac = 1 - exposed/comm`` is then the
+    fraction of collective wall hidden behind compute.
+    """
+    comm_ms = exposed_ms = 0.0
+    by_kind: Dict[str, List[float]] = {}
+    unpaired = 0
+    for plane in planes:
+        if plane_filter not in plane.name:
+            continue
+        for line in plane.lines:
+            if line_filter not in line.name:
+                continue
+            open_starts: Dict[str, List[XEvent]] = {}
+            events = sorted(line.events, key=lambda e: e.offset_ps)
+            for ev in events:
+                name = plane.event_names.get(ev.metadata_id, "")
+                ck = collective_kind(name)
+                if ck is None:
+                    continue
+                kind, phase = ck
+                if phase == "start":
+                    open_starts.setdefault(kind, []).append(ev)
+                    continue
+                if phase == "done" and open_starts.get(kind):
+                    start = open_starts[kind].pop(0)
+                    flight = (ev.offset_ps + ev.duration_ps
+                              - start.offset_ps) / 1e9
+                    exposed = ev.duration_ps / 1e9
+                else:
+                    # sync op, or a done whose start fell outside the
+                    # trace window: fully exposed
+                    flight = exposed = ev.duration_ps / 1e9
+                    if phase == "done":
+                        unpaired += 1
+                comm_ms += flight
+                exposed_ms += exposed
+                cur = by_kind.setdefault(kind, [0.0, 0])
+                cur[0] += flight
+                cur[1] += 1
+            for kind, starts in open_starts.items():
+                for ev in starts:  # start with no done in the window
+                    unpaired += 1
+                    dur = ev.duration_ps / 1e9
+                    comm_ms += dur
+                    exposed_ms += dur
+                    cur = by_kind.setdefault(kind, [0.0, 0])
+                    cur[0] += dur
+                    cur[1] += 1
+    frac = 0.0
+    if comm_ms > 0:
+        frac = min(max(1.0 - exposed_ms / comm_ms, 0.0), 1.0)
+    return {"comm_ms": comm_ms, "exposed_ms": exposed_ms,
+            "overlap_frac": frac, "unpaired": unpaired,
+            "by_kind": {k: (v[0], v[1]) for k, v in by_kind.items()}}
+
+
+def comm_report(path: str, steps: int = 1, plane_filter: str = "TPU",
+                line_filter: str = "XLA Ops") -> Dict[str, object]:
+    """Per-step comm/compute attribution of one trace — the
+    ``comm_sec`` / ``overlap_frac`` gauge source (doc/monitor.md) and
+    the bench ``--dp-scaling`` comm-share numbers.  Falls back to an
+    unfiltered plane scan when nothing matches ``plane_filter`` (CPU
+    runtime traces name their planes differently)."""
+    planes = parse_xspace(find_xplane(path))
+    device_ms = total_ms_in(planes, plane_filter)
+    comm = comm_summary_in(planes, plane_filter, line_filter)
+    if device_ms == 0.0 and comm["comm_ms"] == 0.0 and plane_filter:
+        device_ms = total_ms_in(planes, "")
+        comm = comm_summary_in(planes, "", line_filter)
+    steps = max(int(steps), 1)
+    comm_sec = comm["comm_ms"] / 1e3 / steps
+    device_sec = device_ms / 1e3 / steps
+    return {
+        "steps": steps,
+        "device_sec": round(device_sec, 6),
+        "comm_sec": round(comm_sec, 6),
+        "comm_share": round(comm["comm_ms"] / device_ms, 4)
+        if device_ms else 0.0,
+        "overlap_frac": round(comm["overlap_frac"], 4),
+        "comm_by_kind": {k: round(ms / steps, 3)
+                         for k, (ms, _) in comm["by_kind"].items()},
+    }
+
+
 # --------------------------------------------------------- profiling window
 
 class ProfileWindow:
@@ -240,6 +374,10 @@ class ProfileWindow:
         self.active = False
         self.done = False
         self._steps_traced = 0
+
+    @property
+    def steps_traced(self) -> int:
+        return self._steps_traced
 
     def _start(self) -> None:
         import jax

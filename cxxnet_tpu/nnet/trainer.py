@@ -289,6 +289,19 @@ class NetTrainer:
         # snapshot misreport exactly the cross-trainer contamination it
         # exists to catch
         self.engine_opts_used = None
+        # dp_overlap (parallel/overlap.py): bucket plan built lazily
+        # (after the relu->pool reorder sets deferred-bias flags);
+        # _overlap_defer selects the two-variant accumulate/apply steps
+        # when update_period grad accumulation should reduce once per
+        # APPLY instead of per micro-step (dp_reduce_at = apply)
+        self._dp_plan_state = None
+        self._dp_warned: set = set()
+        self._overlap_step_cache: Dict[Tuple[bool, bool], Any] = {}
+        self._overlap_defer = (
+            self.update_period > 1 and not self.monitor
+            and self.netcfg.extra_data_num == 0
+            and engine.opts.dp_reduce_at == "apply"
+            and self._dp_overlap_active())
         self._train_step = self._build_train_step()
         self._multi_step_cache: Dict[int, Any] = {}
         self._eval_step_cache = {}
@@ -352,6 +365,11 @@ class NetTrainer:
             pkey: opt_group(group, self.opt_state[pkey],
                             self.param_shardings[pkey])
             for pkey, group in self.params.items()}
+        # leaves whose gradient the dp-overlap step may REDUCE-SCATTER
+        # instead of all-reduce (parallel/overlap.py): exactly the leaves
+        # whose optimizer state gets ZeRO-sharded below — the update math
+        # then consumes the grad shard it owns, never the full tensor
+        self.dp_zero_grads = jax.tree.map(lambda _: False, self.params)
         if self.shard_opt_state and "data" in mesh.axis_names:
             ndata = mesh.shape["data"]
 
@@ -364,6 +382,13 @@ class NetTrainer:
                 return cur
             self.opt_shardings = jax.tree.map(
                 opt_spec, self.opt_state, self.opt_shardings)
+
+            def zero_pred(p, sh):
+                return bool(sh is self.repl and p.ndim >= 1
+                            and p.shape[0] % ndata == 0
+                            and p.size >= 2 ** 14)
+            self.dp_zero_grads = jax.tree.map(
+                zero_pred, self.params, self.param_shardings)
         self.buffer_shardings = jax.tree.map(lambda _: self.repl, self.buffers)
         # place initial state
         self.params = jax.device_put(self.params, self.param_shardings)
@@ -833,8 +858,149 @@ class NetTrainer:
                                    epoch, mask, train=True,
                                    body_loss=body_loss)
 
+    # ----------------------------------------------- dp overlap (explicit)
+    def _dp_warn_once(self, reason: str) -> None:
+        if reason not in self._dp_warned:
+            self._dp_warned.add(reason)
+            mlog.warn(f"dp_overlap = 1 ignored: {reason}; using the "
+                      "implicit-psum step")
+
+    def _dp_overlap_plan(self):
+        """Lazily-built bucket plan (parallel/overlap.plan_buckets);
+        ``None`` when eval nodes sit before the loss-tail frontier."""
+        if self._dp_plan_state is None:
+            from ..parallel import overlap
+            plan = overlap.plan_buckets(
+                self.net, self.params, float(engine.opts.dp_bucket_mb),
+                tuple(dict.fromkeys(self.eval_node_ids)))
+            self._dp_plan_state = (plan,)
+            if plan is not None:
+                sizes = [sum(overlap._group_bytes(self.params[k])
+                             for k in ks) for ks in plan.stage_keys]
+                mlog.info(
+                    "dp_overlap: %d buckets (KiB per bucket: %s), "
+                    "reduce_dtype=%s, reduce_at=%s" % (
+                        len(plan.stages),
+                        ",".join(str(s // 1024) for s in sizes),
+                        engine.opts.dp_reduce_dtype,
+                        engine.opts.dp_reduce_at))
+        return self._dp_plan_state[0]
+
+    def _dp_overlap_active(self) -> bool:
+        """True when the explicit bucketed-reduction step should replace
+        the implicit jax.grad psum.  Evaluated at trace time (like every
+        engine option); each unsupported combination falls back to the
+        implicit step with a one-shot warning."""
+        if engine.opts.dp_overlap != "1":
+            return False
+        mesh = self.mesh
+        if "data" not in mesh.axis_names or mesh.shape["data"] < 2:
+            self._dp_warn_once("mesh has no data axis wider than 1")
+            return False
+        if any(mesh.shape[a] > 1 for a in mesh.axis_names if a != "data"):
+            self._dp_warn_once(
+                "mesh has non-data axes (overlap is the pure-DP path)")
+            return False
+        if self._pipelined or self.remat or self.batch_split > 1:
+            self._dp_warn_once("pipe/remat/batch_split paths schedule "
+                               "their own backward")
+            return False
+        if self.buffers:
+            self._dp_warn_once("stateful layers (running buffers, e.g. "
+                               "batch_norm) don't thread through the "
+                               "sliced vjp")
+            return False
+        if self.has_diagnostics:
+            self._dp_warn_once("pairtest diagnostics need the implicit "
+                               "forward")
+            return False
+        if engine.opts.conv_sibling_fuse == "1" \
+                or engine.opts.concat_virtual == "1":
+            self._dp_warn_once("conv_sibling_fuse/concat_virtual rewrite "
+                               "the forward graph")
+            return False
+        if self._dp_overlap_plan() is None:
+            self._dp_warn_once("a train-metric eval node sits before the "
+                               "loss-tail frontier")
+            return False
+        return True
+
+    def _build_overlap_steps(self, with_mask: bool):
+        """The ``dp_reduce_at = apply`` two-variant steps: micro-steps
+        accumulate LOCAL per-device gradient sums (no collectives), the
+        apply step folds the accumulator into the last backward and
+        reduces each bucket ONCE — 1/update_period the communication of
+        the implicit path (the async_updater never pushed partial-period
+        gradients either; DDP calls this no_sync)."""
+        key = with_mask
+        if key in self._overlap_step_cache:
+            return self._overlap_step_cache[key]
+        from ..parallel import overlap
+        eval_ids = tuple(dict.fromkeys(self.eval_node_ids))
+        acc_shardings = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P("data")), self.params)
+        mask_shard = (self.batch_shard,) if with_mask else ()
+
+        def acc_step(params, buffers, grad_acc, data, label_vec, epoch,
+                     rng, *maskarg):
+            self.metrics.counter_inc("train_step_traces")
+            mask = maskarg[0] if with_mask else None
+            loss, outs, new_acc = overlap.accumulate_local(
+                self, params, data, label_vec, epoch, rng, eval_ids,
+                mask, grad_acc)
+            return buffers, new_acc, loss, outs, {}
+
+        acc_fn = jax.jit(
+            acc_step,
+            in_shardings=(self.param_shardings, self.buffer_shardings,
+                          acc_shardings, self.batch_shard,
+                          self.batch_shard, self.repl, self.repl)
+            + mask_shard,
+            out_shardings=(self.buffer_shardings, acc_shardings,
+                           self.repl, self.repl, self.repl),
+            donate_argnums=(1, 2))
+
+        def apply_step(params, opt_state, buffers, grad_acc, data,
+                       label_vec, epoch, rng, *maskarg):
+            self.metrics.counter_inc("train_step_traces")
+            mask = maskarg[0] if with_mask else None
+            loss, outs, grads = overlap.apply_reduce(
+                self, params, data, label_vec, epoch, rng, eval_ids,
+                mask, grad_acc)
+            new_p, new_s = self._apply_update(params, opt_state, grads,
+                                              epoch)
+            new_acc = jax.tree.map(jnp.zeros_like, grad_acc)
+            return new_p, new_s, buffers, new_acc, loss, outs, {}
+
+        apply_fn = jax.jit(
+            apply_step,
+            in_shardings=(self.param_shardings, self.opt_shardings,
+                          self.buffer_shardings, acc_shardings,
+                          self.batch_shard, self.batch_shard,
+                          self.repl, self.repl) + mask_shard,
+            out_shardings=(self.param_shardings, self.opt_shardings,
+                           self.buffer_shardings, acc_shardings,
+                           self.repl, self.repl, self.repl),
+            donate_argnums=(0, 1, 2, 3))
+        self._overlap_step_cache[key] = (acc_fn, apply_fn)
+        return acc_fn, apply_fn
+
     def _loss_and_grads(self, params, buffers, data, label_vec, extras,
                         epoch, rng, eval_ids, mask=None):
+        if extras and engine.opts.dp_overlap == "1":
+            self._dp_warn_once("extra-data inputs are unsupported")
+        if not extras and not self.remat and self._dp_overlap_active():
+            # explicit bucketed backward-overlapped reduction (tentpole
+            # path, parallel/overlap.py).  With update_period > 1 this
+            # runs under the cond step, reducing every micro-step
+            # (dp_reduce_at = step, or monitored runs); reduce-scatter
+            # is reserved for paths whose grads never round-trip through
+            # the replicated grad accumulator
+            from ..parallel import overlap
+            return overlap.loss_and_grads(
+                self, params, buffers, data, label_vec, epoch, rng,
+                eval_ids, mask=mask,
+                scatter_ok=(self.update_period == 1))
         if self.remat:
             # remat = 1 is valid (the whole body as one checkpointed
             # segment: maximum activation saving, maximum recompute)
@@ -1291,6 +1457,20 @@ class NetTrainer:
             h2d_sec=time.perf_counter() - t0)
 
     def _grad_acc_init(self):
+        if getattr(self, "_overlap_defer", False):
+            # per-device LOCAL gradient sums under a leading device axis
+            # sharded over "data" — same per-device footprint as one
+            # replicated copy, but no cross-chip reduction until apply.
+            # Built sharded (jit + out_shardings): materializing the
+            # (ndata, ...) zeros on one device first would transiently
+            # cost ndata x the parameter bytes on that chip
+            shard = NamedSharding(self.mesh, P("data"))
+            ndata = self.mesh.shape["data"]
+            return jax.jit(
+                lambda: jax.tree.map(
+                    lambda p: jnp.zeros((ndata,) + p.shape, p.dtype),
+                    self.params),
+                out_shardings=jax.tree.map(lambda _: shard, self.params))()
         return jax.tree.map(jnp.zeros_like, self.params)
 
     def _note_engine_opts(self) -> None:
@@ -1334,7 +1514,27 @@ class NetTrainer:
         else:
             maskarg = ()
             step_fn = self._train_step
-        if self.update_period > 1:
+        if self.update_period > 1 and getattr(self, "_overlap_defer", False):
+            # dp_reduce_at = apply: separate accumulate/apply programs —
+            # micro-steps run no collectives at all, the apply step
+            # reduces each bucket once with the accumulator folded into
+            # the last backward's grad-ready points
+            assert not extras, \
+                "dp_overlap deferred reduce: extra-data inputs unsupported"
+            if getattr(self, "_grad_acc", None) is None:
+                self._grad_acc = self._grad_acc_init()
+            acc_fn, apply_fn = self._build_overlap_steps(bool(n_padd))
+            if do_update:
+                (self.params, self.opt_state, self.buffers,
+                 self._grad_acc, loss, outs, diags) = apply_fn(
+                    self.params, self.opt_state, self.buffers,
+                    self._grad_acc, data, label_vec, jnp.int32(epoch),
+                    rng, *maskarg)
+            else:
+                (self.buffers, self._grad_acc, loss, outs, diags) = acc_fn(
+                    self.params, self.buffers, self._grad_acc, data,
+                    label_vec, jnp.int32(epoch), rng, *maskarg)
+        elif self.update_period > 1:
             if getattr(self, "_grad_acc", None) is None:
                 self._grad_acc = self._grad_acc_init()
             out = step_fn(

@@ -1,4 +1,4 @@
 
-¥/device:TPU:0XLA Modules"€δ—Π0XLA Ops"€”λά"€Κµξ"€„―_"€ΌΑ–"jit_step"convolution.3"
-copy.2"fusion.1
-2	/host:CPUXLA Ops"	€ξ‰"		hostloop
+κ/device:TPU:0XLA Modules"€δ—ΠXLA Ops"€”λά"€Κµξ€ΒΧ/"€”λά€Κµξ"€¨ΦΉ€Ζ†"€ς‹¨	€„―_"€Π¬σ€ΌΑ–"€ Ωζ€ήΎ"€΄ΔΓ!€"fusion.1"
+copy.2"convolution.3"jit_step"all-reduce-start.1"all-reduce-done.1"reduce-scatter.2" loop-all-reduce-fusion.3
+3	/host:CPUXLA Ops"€ξ‰"	host-loop

@@ -44,3 +44,32 @@ def test_bench_baseline_json_shape():
     assert payload["metric"] == "alexnet_imgs_per_sec_per_chip"
     assert payload["value"] == 1234.6
     assert payload["vs_baseline"] == round(1234.56 / 1000.0, 3)
+
+
+def test_bench_dp_scaling_mode():
+    """--dp-scaling payload on the CPU mesh: per-device-count per-chip
+    throughput, scaling efficiency vs the 1-device point, and
+    comm/compute shares, overlap on vs off."""
+    import bench
+    payload = bench.bench_dp_scaling(
+        ["dev=cpu", "tiny=1", "devices=1,2", "models=alexnet"])
+    assert payload["metric"] == "dp_scaling_examples_per_sec_per_chip"
+    assert payload["value"] > 0
+    assert payload["devices"] == [1, 2]
+    pts = payload["models"]["alexnet"]["points"]
+    assert [p["devices"] for p in pts] == [1, 2]
+    for row in pts:
+        for tag in ("overlap_on", "overlap_off"):
+            p = row[tag]
+            assert p["examples_per_sec_per_chip"] > 0
+            assert p["scaling_efficiency"] > 0
+            assert 0.0 <= p["comm_share"] <= 1.0
+            assert 0.0 <= p["compute_share"] <= 1.0
+            assert 0.0 <= p["overlap_frac"] <= 1.0
+    # the 1-device point anchors efficiency at exactly 1.0
+    assert payload["efficiency_baseline_devices"] == 1
+    assert pts[0]["overlap_on"]["scaling_efficiency"] == 1.0
+    assert pts[0]["overlap_off"]["scaling_efficiency"] == 1.0
+    # engine options restored (process-global hygiene)
+    from cxxnet_tpu.engine import opts
+    assert opts.dp_overlap == "0"

@@ -1,0 +1,30 @@
+"""Collection-integrity guard: ``pytest --collect-only`` over tests/
+must report ZERO collection errors.
+
+The tier-1 command runs with ``--continue-on-collection-errors``, so a
+test file that stops importing (a renamed module, a stale symbol) shows
+up only as silently-missing dots — every test in the broken file skips
+without failing the run.  This guard turns an import break into a real
+failure."""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_collect_only_has_zero_errors():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "--collect-only",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    tail = (r.stdout + r.stderr)[-4000:]
+    assert r.returncode == 0, f"collection failed:\n{tail}"
+    assert "error" not in r.stdout.lower().splitlines()[-1], tail
+    # sanity: the suite actually collected a healthy number of tests
+    m = re.search(r"(\d+) tests? collected", r.stdout)
+    assert m, tail
+    assert int(m.group(1)) > 200, f"only {m.group(1)} tests collected"

@@ -272,6 +272,53 @@ def test_prefetcher_sync_mode_exception_propagates():
     pf.close()
 
 
+def test_training_diverged_joins_producer_threads(tmp_path):
+    """monitor_nan=fatal mid-round: TrainingDiverged must propagate out
+    of the CLI run WITHOUT leaking the device-staging producer thread
+    (ISSUE 4 satellite: the task's finally joins it, not process exit)."""
+    from cxxnet_tpu.monitor import TrainingDiverged
+    baseline = threading.active_count()
+    sink = tmp_path / "m.jsonl"
+    conf = _write_conf(tmp_path, 64, """
+monitor = 1
+monitor_interval = 1
+monitor_nan = fatal
+""", sink)
+    task = LearnTask()
+    with pytest.raises(TrainingDiverged):
+        # eta large enough that the first monitored step sees a
+        # non-finite loss deterministically
+        task.run([str(conf), "prefetch_device=2", "save_model=0",
+                  "eta=1e30"])
+    assert threading.active_count() == baseline, \
+        "producer thread leaked past TrainingDiverged"
+
+
+def test_midround_exception_joins_eval_prefetchers(tmp_path, monkeypatch):
+    """An exception in round 2 — after the per-eval prefetchers were
+    created by round 1's evaluation — joins THEIR producer threads too
+    (they are closed in task_train's finally, not only at run() exit)."""
+    baseline = threading.active_count()
+    sink = tmp_path / "m.jsonl"
+    conf = _write_conf(tmp_path, 64, "", sink)
+    calls = {"n": 0}
+    orig = NetTrainer.update
+
+    def boom(self, batch):
+        calls["n"] += 1
+        if calls["n"] > 5:  # 4 steps/round: round 2, mid-round
+            raise RuntimeError("mid-round failure")
+        return orig(self, batch)
+
+    monkeypatch.setattr(NetTrainer, "update", boom)
+    task = LearnTask()
+    with pytest.raises(RuntimeError, match="mid-round failure"):
+        task.run([str(conf), "prefetch_device=2", "save_model=0"])
+    assert task._eval_prefetchers is None, \
+        "eval prefetchers must be closed by the task's finally"
+    assert threading.active_count() == baseline
+
+
 def test_prefetcher_thread_hygiene_across_epochs():
     """threading.active_count() returns to baseline after close(), with
     no per-epoch thread accumulation across before_first() cycles."""

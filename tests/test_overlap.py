@@ -1,0 +1,357 @@
+"""Bucketed backward-overlapped DP gradient reduction
+(cxxnet_tpu/parallel/overlap.py): bitwise trajectory parity against the
+implicit-psum step on a CPU ``data:4`` mesh (tail-mask, update_period,
+shard_opt_state configs), per-bucket reduction calls visible in the
+lowered HLO, deferred once-per-apply reduction, ZeRO reduce-scatter
+composition, bf16 wire dtype, and the fallback gates."""
+
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cxxnet_tpu import engine  # noqa: E402
+from cxxnet_tpu.io.data import DataBatch  # noqa: E402
+
+from __graft_entry__ import _make_trainer  # noqa: E402
+
+CONV_NET = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  stride = 2
+  nchannel = 8
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[3->4] = flatten
+layer[4->5] = fullc:fc1
+  nhidden = 32
+layer[5->6] = relu
+layer[6->7] = fullc:fc2
+  nhidden = 4
+layer[7->7] = softmax
+netconfig=end
+input_shape = 3,16,16
+metric = error
+eta = 0.1
+momentum = 0.9
+silent = 1
+"""
+
+# fc1 (256, 144) = 147k f32: crosses the ZeRO size floor (2^14 leaves)
+MLP_ZERO_NET = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 256
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,144
+metric = error
+eta = 0.1
+momentum = 0.9
+silent = 1
+"""
+
+DP_OPTS = ("dp_overlap", "dp_bucket_mb", "dp_reduce_dtype", "dp_reduce_at")
+
+
+@pytest.fixture(autouse=True)
+def _restore_engine_opts():
+    saved = {k: getattr(engine.opts, k) for k in DP_OPTS}
+    yield
+    for k, v in saved.items():
+        engine.opts.set(k, v)
+
+
+def _batches(n, batch=16, shape=(3, 16, 16), classes=4, tail_padd=0):
+    rnd = np.random.RandomState(0)
+    out = []
+    for i in range(n):
+        b = DataBatch(
+            data=rnd.rand(batch, *shape).astype(np.float32),
+            label=rnd.randint(0, classes, (batch, 1)).astype(np.float32),
+            index=np.arange(batch, dtype=np.uint32))
+        if tail_padd and i == n - 1:
+            b.tail_mask_padd = tail_padd
+        out.append(b)
+    return out
+
+
+def _train(net, overlap, extra=(), *, bucket_mb="0.001",
+           reduce_at="apply", reduce_dtype="f32", n_steps=4,
+           shape=(3, 16, 16), tail_padd=0):
+    """One fresh trainer, n_steps updates; returns (losses, params,
+    opt_state, trainer).  Engine options are process-global and read at
+    trace time, so each run sets them BEFORE its first update and the
+    autouse fixture restores them (the experiments/ab.py discipline)."""
+    engine.opts.set("dp_overlap", "1" if overlap else "0")
+    engine.opts.set("dp_bucket_mb", bucket_mb)
+    engine.opts.set("dp_reduce_at", reduce_at)
+    engine.opts.set("dp_reduce_dtype", reduce_dtype)
+    t = _make_trainer(net, 16, "cpu:0-3", extra=[("mesh", "data:4")]
+                      + list(extra))
+    t.start_round(1)
+    losses = []
+    for b in _batches(n_steps, shape=shape, tail_padd=tail_padd):
+        t.update(b)
+        losses.append(float(np.asarray(t._last_loss)))
+    return (losses, jax.tree.map(np.asarray, t.params),
+            jax.tree.map(np.asarray, t.opt_state), t)
+
+
+def _assert_trees_equal(a, b, what):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y, err_msg=what)
+
+
+# ------------------------------------------------------------- parity
+
+@pytest.mark.parametrize("tag,net,extra,kw", [
+    ("plain", CONV_NET, (), {}),
+    ("tail_mask", CONV_NET, (), {"tail_padd": 5}),
+    ("zero", MLP_ZERO_NET, (("shard_opt_state", "1"),),
+     {"shape": (1, 1, 144)}),
+    # update_period at dp_reduce_at=step: reductions per micro-step, in
+    # the implicit path's summation order -> bitwise
+    ("update_period", CONV_NET, (("update_period", "2"),),
+     {"reduce_at": "step"}),
+])
+def test_dp_overlap_bitwise_parity(tag, net, extra, kw):
+    """dp_overlap=1 trajectory == the implicit-psum DP step, bitwise, at
+    dp_reduce_dtype=f32 on a CPU data:4 mesh: per-step losses, final
+    params, AND optimizer state (including ZeRO-sharded leaves fed by
+    reduce-scatter)."""
+    off = _train(net, False, extra, **kw)
+    on = _train(net, True, extra, **kw)
+    assert off[0] == on[0], f"{tag}: per-step losses must be bitwise equal"
+    _assert_trees_equal(off[1], on[1], f"{tag}: params diverged")
+    _assert_trees_equal(off[2], on[2], f"{tag}: optimizer state diverged")
+
+
+def test_dp_overlap_deferred_reduce_once_per_apply():
+    """dp_reduce_at=apply (the default): micro-steps run ZERO gradient
+    collectives (the accumulate program's only all-reduce is the loss
+    scalar), the apply step reduces each bucket once with the
+    accumulator folded in.  The cross-chip sum reassociates, so the
+    trajectory matches the implicit path to FP tolerance, with losses
+    (pure forward) still bitwise."""
+    off = _train(CONV_NET, False, (("update_period", "2"),))
+    on = _train(CONV_NET, True, (("update_period", "2"),),
+                reduce_at="apply")
+    assert off[0] == on[0], "forward losses must be bitwise equal"
+    for x, y in zip(jax.tree.leaves(off[1]), jax.tree.leaves(on[1])):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-7)
+    t = on[3]
+    assert t._overlap_defer
+    acc_fn, apply_fn = t._build_overlap_steps(False)
+    data = jnp.zeros((16, 3, 16, 16), jnp.float32)
+    label = jnp.zeros((16, 1), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    acc = t._grad_acc_init()
+    acc_txt = acc_fn.lower(t.params, t.buffers, acc, data, label,
+                           jnp.int32(0), rng).as_text()
+    apply_txt = apply_fn.lower(t.params, t.opt_state, t.buffers, acc,
+                               data, label, jnp.int32(0), rng).as_text()
+    assert len(re.findall(r"all_reduce", acc_txt)) == 1, \
+        "accumulate micro-step must reduce nothing but the loss scalar"
+    assert len(re.findall(r"all_reduce", apply_txt)) >= 3, \
+        "apply step must carry the per-bucket reductions"
+
+
+# ------------------------------------------------------ lowered programs
+
+def test_dp_overlap_hlo_has_per_bucket_reductions():
+    """The overlapped step's lowered HLO contains one reduction PER
+    BUCKET (>= 2 distinct calls beyond the loss scalar — proving
+    per-bucket issue, not one fused end-of-backward reduce); the
+    implicit step lowers zero explicit collectives (GSPMD inserts its
+    psum later, at partitioning time)."""
+    on = _train(CONV_NET, True, n_steps=1)
+    t = on[3]
+    n_buckets = len(t._dp_overlap_plan().stages)
+    assert n_buckets >= 2
+    data = jnp.zeros((16, 3, 16, 16), jnp.float32)
+    label = jnp.zeros((16, 1), jnp.float32)
+    args = (t.params, t.opt_state, t.buffers, data, label, (),
+            jnp.int32(0), jax.random.PRNGKey(0))
+    engine.opts.set("dp_overlap", "1")
+    txt = t._train_step.lower(*args).as_text()
+    # buckets + the loss psum; >= 2 distinct reductions is the
+    # acceptance floor, the plan predicts the exact count
+    n_red = len(re.findall(r"all_reduce", txt))
+    assert n_red >= 2
+    assert n_red >= n_buckets
+
+    off = _train(CONV_NET, False, n_steps=1)
+    t0 = off[3]
+    txt0 = t0._train_step.lower(
+        t0.params, t0.opt_state, t0.buffers, data, label, (),
+        jnp.int32(0), jax.random.PRNGKey(0)).as_text()
+    assert "all_reduce" not in txt0
+
+
+def test_dp_overlap_zero_leaves_reduce_scatter():
+    """shard_opt_state=1 composes: buckets holding ZeRO-sharded leaves
+    REDUCE-SCATTER those grads (each device receives only the shard its
+    optimizer state owns) instead of all-reducing."""
+    on = _train(MLP_ZERO_NET, True, (("shard_opt_state", "1"),),
+                shape=(1, 1, 144), n_steps=1)
+    t = on[3]
+    assert any(jax.tree.leaves(t.dp_zero_grads)), \
+        "test net must have at least one ZeRO-sharded leaf"
+    data = jnp.zeros((16, 1, 1, 144), jnp.float32)
+    label = jnp.zeros((16, 1), jnp.float32)
+    engine.opts.set("dp_overlap", "1")
+    txt = t._train_step.lower(
+        t.params, t.opt_state, t.buffers, data, label, (),
+        jnp.int32(0), jax.random.PRNGKey(0)).as_text()
+    assert "reduce_scatter" in txt
+
+
+# ------------------------------------------------------------- variants
+
+def test_dp_overlap_bf16_reduce_dtype():
+    """dp_reduce_dtype=bf16: grads cross the wire in bf16, apply stays
+    f32-mastered — the trajectory tracks the f32 run loosely (one bf16
+    mantissa of reduction noise per step)."""
+    f32 = _train(CONV_NET, True, n_steps=3)
+    bf16 = _train(CONV_NET, True, n_steps=3, reduce_dtype="bf16")
+    assert np.isfinite(bf16[0]).all()
+    np.testing.assert_allclose(bf16[0], f32[0], rtol=0.05)
+    for x, y in zip(jax.tree.leaves(bf16[1]), jax.tree.leaves(f32[1])):
+        np.testing.assert_allclose(x, y, rtol=0.1, atol=5e-3)
+
+
+def test_dp_overlap_multi_step_scan_parity():
+    """update_many (the multi_step grouped dispatch) routes through the
+    same overlapped loss_and_grads inside its lax.scan."""
+    def run(overlap):
+        engine.opts.set("dp_overlap", "1" if overlap else "0")
+        engine.opts.set("dp_bucket_mb", "0.0001")
+        t = _make_trainer(CONV_NET, 16, "cpu:0-3",
+                          extra=[("mesh", "data:4")])
+        rnd = np.random.RandomState(0)
+        datas = rnd.rand(3, 16, 3, 16, 16).astype(np.float32)
+        labels = rnd.randint(0, 4, (3, 16, 1)).astype(np.float32)
+        t.start_round(1)
+        losses = np.asarray(t.update_many(datas, labels))
+        return losses, jax.tree.map(np.asarray, t.params)
+
+    off = run(False)
+    on = run(True)
+    np.testing.assert_array_equal(off[0], on[0])
+    _assert_trees_equal(off[1], on[1], "multi_step params diverged")
+
+
+def test_dp_overlap_falls_back_for_batch_norm(capsys):
+    """Running-buffer layers (batch_norm) can't thread through the
+    sliced vjp: the trainer warns once and keeps the implicit step —
+    never silently wrong math."""
+    net = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+layer[1->2] = batch_norm
+layer[2->3] = relu
+layer[3->4] = fullc:fc2
+  nhidden = 4
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,1,144
+metric = error
+eta = 0.1
+silent = 1
+"""
+    engine.opts.set("dp_overlap", "1")
+    t = _make_trainer(net, 16, "cpu:0-3", extra=[("mesh", "data:4")])
+    t.start_round(1)
+    (b,) = _batches(1, shape=(1, 1, 144))
+    t.update(b)
+    assert np.isfinite(float(np.asarray(t._last_loss)))
+    err = capsys.readouterr().err
+    assert "dp_overlap = 1 ignored" in err and "batch_norm" in err
+
+
+def test_dp_overlap_single_device_falls_back(capsys):
+    """A one-device mesh has nothing to reduce: implicit step, warning."""
+    engine.opts.set("dp_overlap", "1")
+    t = _make_trainer(CONV_NET, 16, "cpu:0")
+    t.start_round(1)
+    (b,) = _batches(1)
+    t.update(b)
+    assert np.isfinite(float(np.asarray(t._last_loss)))
+    assert "dp_overlap = 1 ignored" in capsys.readouterr().err
+
+
+def test_dp_overlap_cli_config_keys(tmp_path):
+    """dp_overlap / dp_bucket_mb / dp_reduce_dtype ride the config
+    surface end to end: a .conf trains through LearnTask on a data:4
+    mesh bitwise-identically with the explicit step on vs off."""
+    import json
+
+    from cxxnet_tpu.main import LearnTask
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_main import MLP_NET, _write_synth_mnist
+    _write_synth_mnist(tmp_path, n=64)
+    conf = tmp_path / "dp.conf"
+    conf.write_text(f"""
+dev = cpu:0-3
+mesh = data:4
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{MLP_NET}
+input_shape = 1,1,144
+batch_size = 16
+eta = 0.05
+num_round = 2
+metric = error
+print_step = 1
+silent = 1
+save_model = 0
+dp_bucket_mb = 0.0001
+""")
+    losses = {}
+    for ov in ("0", "1"):
+        sink = tmp_path / f"m{ov}.jsonl"
+        task = LearnTask()
+        assert task.run([str(conf), f"dp_overlap={ov}",
+                         f"metrics_sink=jsonl:{sink}"]) == 0
+        recs = [json.loads(l) for l in open(sink)]
+        losses[ov] = [r["loss"] for r in recs if r["kind"] == "step"]
+        engine.opts.set("dp_overlap", "0")
+    assert losses["0"] and losses["0"] == losses["1"]
+
+
+def test_plan_buckets_reverse_order_sizing():
+    """Bucket boundaries honor the size target in reverse layer order:
+    a tiny target gives one bucket per param-owning segment, a huge one
+    collapses to a single bucket."""
+    from cxxnet_tpu.parallel import overlap
+    t = _train(CONV_NET, False, n_steps=0)[3]
+    eval_ids = tuple(dict.fromkeys(t.eval_node_ids))
+    tiny = overlap.plan_buckets(t.net, t.params, 1e-6, eval_ids)
+    assert len(tiny.stages) == 3  # cv1 | fc1 | fc2 segments
+    assert tiny.stages[0][0] == 0
+    assert tiny.stages[-1][1] == tiny.body_end
+    big = overlap.plan_buckets(t.net, t.params, 1024.0, eval_ids)
+    assert len(big.stages) == 1
+    # contiguity: stage k ends where stage k+1 starts
+    for (a0, a1), (b0, b1) in zip(tiny.stages, tiny.stages[1:]):
+        assert a1 == b0

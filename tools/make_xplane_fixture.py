@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Regenerate tests/fixtures/minimal.xplane.pb deterministically.
+
+A hand-rolled protobuf wire ENCODER matching the decoder in
+cxxnet_tpu/monitor/trace.py (field numbers from xplane.proto:
+XSpace.planes=1; XPlane.name=2/lines=3/event_metadata=4; XLine.name=2/
+events=4; XEvent.metadata_id=1/offset_ps=2/duration_ps=3;
+XEventMetadata.id=1/name=2).  The fixture carries:
+
+* a TPU plane with an "XLA Modules" line (jit_step, 5 ms) and an
+  "XLA Ops" line holding compute ops (fusion.1 x2 = 1.5 ms, copy.2
+  0.2 ms, convolution.3 3.0 ms), an async collective PAIR
+  (all-reduce-start.1 / all-reduce-done.1, in-flight 0.5..2.3 ms,
+  exposed 0.3 ms), a sync collective (reduce-scatter.2, 0.4 ms), and a
+  substring TRAP (loop-all-reduce-fusion.3: a fusion whose NAME contains
+  "all-reduce" — the classifier must not book it as comm; this is the
+  round-5 "copy-done" bug class, BASELINE.md round 5);
+* a host plane the default TPU filters must exclude (7 ms).
+
+Run from the repo root:  python tools/make_xplane_fixture.py
+"""
+
+from __future__ import annotations
+
+import os
+
+MS = 10 ** 9  # milliseconds -> picoseconds
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_varint(num: int, val: int) -> bytes:
+    return _varint(num << 3 | 0) + _varint(val)
+
+
+def _field_len(num: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | 2) + _varint(len(payload)) + payload
+
+
+def event(mid: int, dur_ps: int, off_ps: int = 0) -> bytes:
+    out = _field_varint(1, mid)
+    if off_ps:
+        out += _field_varint(2, off_ps)
+    return out + _field_varint(3, dur_ps)
+
+
+def line(name: str, events: list) -> bytes:
+    out = _field_len(2, name.encode())
+    for e in events:
+        out += _field_len(4, e)
+    return out
+
+
+def metadata_entry(mid: int, name: str) -> bytes:
+    meta = _field_varint(1, mid) + _field_len(2, name.encode())
+    return _field_varint(1, mid) + _field_len(2, meta)
+
+
+def plane(name: str, lines: list, names: dict) -> bytes:
+    out = _field_len(2, name.encode())
+    for ln in lines:
+        out += _field_len(3, ln)
+    for mid, nm in sorted(names.items()):
+        out += _field_len(4, metadata_entry(mid, nm))
+    return out
+
+
+def build() -> bytes:
+    tpu_names = {
+        1: "fusion.1", 2: "copy.2", 3: "convolution.3", 4: "jit_step",
+        5: "all-reduce-start.1", 6: "all-reduce-done.1",
+        7: "reduce-scatter.2", 8: "loop-all-reduce-fusion.3",
+    }
+    tpu = plane("/device:TPU:0", [
+        line("XLA Modules", [event(4, 5 * MS)]),
+        line("XLA Ops", [
+            event(1, MS, 0),
+            event(5, MS // 10, MS // 2),          # start: 0.5..0.6 ms
+            event(1, MS // 2, MS),
+            event(6, 3 * MS // 10, 2 * MS),       # done: 2.0..2.3 ms
+            event(2, MS // 5, 2 * MS + MS // 2),
+            event(3, 3 * MS, 4 * MS),
+            event(7, 2 * MS // 5, 8 * MS),        # sync reduce-scatter
+            event(8, 3 * MS // 5, 9 * MS),        # the substring trap
+        ]),
+    ], tpu_names)
+    host = plane("/host:CPU", [
+        line("XLA Ops", [event(1, 7 * MS)]),
+    ], {1: "host-loop"})
+    return _field_len(1, tpu) + _field_len(1, host)
+
+
+def main() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "tests", "fixtures", "minimal.xplane.pb")
+    with open(path, "wb") as f:
+        f.write(build())
+    print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

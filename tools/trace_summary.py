@@ -25,16 +25,24 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from cxxnet_tpu.monitor.trace import (find_xplane, op_totals_in,  # noqa: E402
-                                      parse_xspace, total_ms_in)
+from cxxnet_tpu.monitor.trace import (collective_kind,  # noqa: E402
+                                      comm_summary_in, find_xplane,
+                                      op_totals_in, parse_xspace,
+                                      total_ms_in)
 
 
 def summarize(path: str, top: int, plane: str, line: str) -> dict:
     xplane = find_xplane(path)
-    planes = parse_xspace(xplane)  # parse ONCE; both views read from it
+    planes = parse_xspace(xplane)  # parse ONCE; all views read from it
     totals = op_totals_in(planes, plane_filter=plane, line_filter=line)
     ranked = sorted(((name, ms, n) for name, (ms, n) in totals.items()),
                     key=lambda t: -t[1])
+
+    def comm_tag(name):
+        ck = collective_kind(name)
+        return ck[0] if ck else ""
+
+    comm = comm_summary_in(planes, plane_filter=plane, line_filter=line)
     out = {
         "trace": xplane,
         "plane_filter": plane,
@@ -42,9 +50,17 @@ def summarize(path: str, top: int, plane: str, line: str) -> dict:
         "device_total_ms": round(
             total_ms_in(planes, plane_filter=plane), 3),
         "ops_total_ms": round(sum(ms for _, (ms, _) in totals.items()), 3),
-        "top_ops": [{"op": name, "total_ms": round(ms, 3), "count": n}
+        "top_ops": [{"op": name, "total_ms": round(ms, 3), "count": n,
+                     "comm": comm_tag(name)}
                     for name, ms, n in ranked[:top]],
         "dropped_ops": max(len(ranked) - top, 0),
+        # collectives in their own bucket (start/done pairs counted once
+        # by in-flight span; see trace.comm_summary_in)
+        "comm_total_ms": round(comm["comm_ms"], 3),
+        "comm_exposed_ms": round(comm["exposed_ms"], 3),
+        "comm_overlap_frac": round(comm["overlap_frac"], 4),
+        "comm_by_kind": {k: (round(ms, 3), n)
+                         for k, (ms, n) in comm["by_kind"].items()},
     }
     if not ranked:
         # nothing matched the filters (e.g. a CPU-runtime trace whose
@@ -80,11 +96,18 @@ def main(argv=None) -> int:
     print(f"trace: {s['trace']}")
     print(f"device total (XLA Modules, plane~{args.plane}): "
           f"{s['device_total_ms']:.3f} ms")
+    if s["comm_total_ms"]:
+        kinds = ", ".join(f"{k} {ms:.3f} ms x{n}"
+                          for k, (ms, n) in s["comm_by_kind"].items())
+        print(f"comm total: {s['comm_total_ms']:.3f} ms "
+              f"(exposed {s['comm_exposed_ms']:.3f} ms, "
+              f"overlap_frac {s['comm_overlap_frac']:.2f}) [{kinds}]")
     ops_total = s["ops_total_ms"] or 1e-12
-    print(f"{'total_ms':>12} {'count':>8} {'%ops':>6}  op")
+    print(f"{'total_ms':>12} {'count':>8} {'%ops':>6} {'comm':>15}  op")
     for row in s["top_ops"]:
         print(f"{row['total_ms']:12.3f} {row['count']:8d} "
-              f"{100.0 * row['total_ms'] / ops_total:6.1f}  {row['op']}")
+              f"{100.0 * row['total_ms'] / ops_total:6.1f} "
+              f"{row['comm'] or '-':>15}  {row['op']}")
     if s["dropped_ops"]:
         print(f"... {s['dropped_ops']} more ops below top-{args.top} "
               f"(--top to widen)")
