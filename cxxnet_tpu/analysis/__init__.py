@@ -1,0 +1,82 @@
+"""Static analysis: config lint (conflint) + traced-graph lint (jaxpr_lint).
+
+``run_check`` is the shared driver behind ``task = check`` (main.py) and
+``tools/graftlint.py``.  Only the dependency-free schema is imported
+eagerly; the lint passes import the full framework lazily so
+``layers/base.py`` (which imports :mod:`.schema` for its key
+declarations) never cycles through here.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .schema import Finding, K, KeySpec  # noqa: F401 (re-export)
+
+
+def run_check(cfg, path: str = "", trace: bool = True
+              ) -> Tuple[List[Finding], int]:
+    """Lint an ordered config-pair list; returns (findings, exit_code).
+
+    Static config lint always runs; the traced-graph lint additionally
+    builds the configured net on CPU and walks the step jaxpr when the
+    config carries a ``netconfig`` block (pred-from-checkpoint configs
+    don't) and ``trace`` is on.  Exit code 1 iff any error-severity
+    finding."""
+    from . import conflint
+    findings = conflint.lint_pairs(cfg, path=path)
+    has_net = any(k.startswith("layer[") for k, _ in cfg)
+    if not trace:
+        pass
+    elif not has_net:
+        findings.append(Finding(
+            "info", "", "no netconfig block in this config; "
+            "traced-graph lint skipped", scope="jaxpr"))
+    else:
+        findings.extend(_trace_findings(cfg))
+    n_err = sum(1 for f in findings if f.severity == "error")
+    return findings, (1 if n_err else 0)
+
+
+def _trace_findings(cfg) -> List[Finding]:
+    """Build the configured trainer on CPU and lint its traced step.
+    Build failures become findings instead of crashes: a config whose net
+    cannot even be constructed (bad shapes, undefined nodes) is exactly
+    what ``task=check`` exists to report."""
+    from . import jaxpr_lint
+    from .. import engine
+    from ..monitor import log as mlog
+    from ..nnet.trainer import NetTrainer
+    from ..utils.config import ConfigError
+    from .schema import Finding as F
+    net = NetTrainer()
+    was_silent = mlog.is_silent()
+    # engine options are a process-global singleton the config mutates at
+    # build time; the trace must run WITH this config's options, but a
+    # multi-config graftlint run must not leak them into the next config
+    engine_snap = engine.snapshot()
+    try:
+        try:
+            for k, v in cfg:
+                net.set_param(k, v)
+            # no device work: abstract tracing on the host platform.
+            # "cpu" wins over the config's dev= because set_param assigns
+            # directly; the build chatter (net description) is lint noise
+            net.set_param("dev", "cpu")
+            net.set_param("silent", "1")
+            net.init_model()
+        except (ConfigError, AssertionError, ValueError, KeyError) as e:
+            return [F("error", "", f"net build failed: {e}", scope="jaxpr")]
+        except Exception as e:  # noqa: BLE001 — environment, not config
+            return [F("warn", "", "traced-graph lint skipped: could not "
+                      f"build the train step on cpu ({e})", scope="jaxpr")]
+        finally:
+            mlog.set_silent(1 if was_silent else 0)
+        try:
+            return jaxpr_lint.lint_trainer(net)
+        except Exception as e:  # noqa: BLE001 — lint must not crash check
+            return [F("warn", "", f"traced-graph lint failed: {e}",
+                      scope="jaxpr")]
+    finally:
+        for k, v in engine_snap.items():
+            setattr(engine.opts, k, v)

@@ -1,0 +1,359 @@
+"""Config lint: unknown keys, value violations, cross-key constraints.
+
+The reference's config contract silently ignores unknown keys
+(``layers/base.py`` Layer.set_param), so a typo'd ``dp_bucket_mb`` or a
+misspelled layer key costs a full compile-and-train cycle before anyone
+notices.  ``lint_pairs`` walks an ordered config-pair list with the same
+sectioning rules the runtime uses (``main._create_iterators`` for
+``data``/``eval``/``pred`` blocks, ``NetConfig.configure`` for the
+netconfig block) and checks every key against the declared-key registry:
+
+* **unknown everywhere** → error with a did-you-mean suggestion;
+* **known globally but not consumed here** (e.g. an ``img``-only key in
+  an ``imgbin`` section) → warning, because the runtime will silently
+  drop it;
+* **value violations** → type/enum failures are errors, range
+  excursions warnings (schema.check_value);
+* **cross-key constraints** → the interaction rules the subsystems
+  enforce with trace-time warnings or silent fallbacks (dp_overlap
+  vs batch_split/pipe, monitor vs multi_step, ...), surfaced before any
+  device work.
+
+Structural netconfig problems (undefined nodes, shared-layer params)
+are caught by running ``NetConfig.configure`` itself and converting its
+exceptions into findings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import registry
+from .schema import Finding, check_value, did_you_mean
+
+ConfigPairs = Sequence[Tuple[str, str]]
+
+# structural sectioning keys handled by position, not by the registry
+_SECTION_HEADS = {"data": 1, "eval": 2, "pred": 3}
+
+
+def lint_pairs(pairs: ConfigPairs, path: str = "") -> List[Finding]:
+    findings: List[Finding] = []
+    flag = 0                      # 0 global, else inside data/eval/pred
+    sect_name = ""
+    sect: List[Tuple[str, str]] = []
+    netcfg_mode = 0               # NetConfig.configure's state machine
+    cur_layer: Optional[Tuple[str, str]] = None  # (type, name)
+    layer_types: List[str] = []
+    sections_seen: Dict[int, int] = {}
+
+    for name, val in pairs:
+        if flag != 0:
+            if name in _SECTION_HEADS:
+                findings.append(Finding(
+                    "error", name, f"new {name!r} section opened before "
+                    f"'iter = end' closed the {sect_name!r} section",
+                    scope=f"iter:{sect_name}"))
+                _lint_section(sect_name, sect, findings)
+                flag, sect = _SECTION_HEADS[name], []
+                sect_name = val if name == "eval" else name
+                sections_seen[flag] = sections_seen.get(flag, 0) + 1
+                continue
+            if name == "iter" and val == "end":
+                _lint_section(sect_name, sect, findings)
+                flag, sect = 0, []
+                continue
+            sect.append((name, val))
+            continue
+        if name in _SECTION_HEADS:
+            flag = _SECTION_HEADS[name]
+            sect_name = val if name == "eval" else name
+            sections_seen[flag] = sections_seen.get(flag, 0) + 1
+            sect = []
+            continue
+        if name == "iter":
+            findings.append(Finding(
+                "error", name, "'iter = %s' outside a data/eval/pred "
+                "section" % val))
+            continue
+        if name == "netconfig":
+            if val not in ("start", "end"):
+                findings.append(Finding(
+                    "error", name, f"netconfig = {val!r}: expected start "
+                    "or end"))
+            netcfg_mode = 1 if val == "start" else 0
+            cur_layer = None
+            continue
+        if name.startswith("layer["):
+            cur_layer = _lint_layer_line(name, val, findings)
+            if cur_layer is not None:
+                layer_types.append(cur_layer[0])
+            netcfg_mode = 2
+            continue
+        if netcfg_mode == 2 and cur_layer is not None:
+            _lint_layer_key(cur_layer, name, val, findings)
+            continue
+        # global region (netcfg_mode 0 or 1, and layer lines the parser
+        # rejected): the broadcast scope
+        _lint_global_key(name, val, findings)
+
+    if flag != 0:
+        findings.append(Finding(
+            "error", "iter", f"{sect_name!r} section never closed with "
+            "'iter = end'", scope=f"iter:{sect_name}"))
+        _lint_section(sect_name, sect, findings)
+
+    findings.extend(_structural_findings(pairs))
+    _cross_key_rules(pairs, layer_types, sections_seen, findings)
+    return findings
+
+
+# --------------------------------------------------------------- pieces
+def _lint_global_key(name: str, val: str, findings: List[Finding]) -> None:
+    scope = registry.global_scope()
+    specs = scope.match(name)
+    if not specs:
+        sugg = did_you_mean(name, scope.names())
+        findings.append(Finding(
+            "error", name, "unknown config key (no layer, iterator, "
+            "updater, engine, or task declares it); it would be silently "
+            "ignored", suggestion=sugg, scope="global"))
+        return
+    _lint_value(specs, name, val, "global", findings)
+
+
+def _lint_value(specs, name: str, val: str, scope_name: str,
+                findings: List[Finding]) -> None:
+    viols = []
+    for sp in specs:
+        v = check_value(sp, val)
+        if v is None:
+            return
+        viols.append(v)
+    sev, msg = viols[0]
+    findings.append(Finding(sev, name, msg, scope=scope_name))
+
+
+def _lint_section(sect_name: str, entries: ConfigPairs,
+                  findings: List[Finding]) -> None:
+    from ..io import factory
+    scope_name = f"iter:{sect_name}"
+    chain = tuple(v for k, v in entries if k == "iter")
+    for t in chain:
+        if factory.iter_stage_classes(t) is None and t != "end":
+            findings.append(Finding(
+                "error", "iter", f"unknown iterator type {t!r}",
+                suggestion=did_you_mean(t, factory.iter_type_names()),
+                scope=scope_name))
+    scope = registry.iterator_scope(chain)
+    for k, v in entries:
+        if k == "iter":
+            continue
+        specs = scope.match(k)
+        if specs:
+            _lint_value(specs, k, v, scope_name, findings)
+        elif registry.known_anywhere(k):
+            findings.append(Finding(
+                "warn", k, "not consumed by any stage of this iterator "
+                f"chain ({'+'.join(chain) or 'empty'}); it will be "
+                "silently ignored here", scope=scope_name))
+        else:
+            findings.append(Finding(
+                "error", k, "unknown config key",
+                suggestion=did_you_mean(
+                    k, scope.names() or registry.global_scope().names()),
+                scope=scope_name))
+
+
+def _layer_type_known(tname: str) -> bool:
+    from ..layers import registry as lreg
+    if tname.startswith("pairtest-"):
+        rest = tname[len("pairtest-"):]
+        if "-" not in rest:
+            return False
+        master, slave = rest.split("-", 1)
+        return _layer_type_known(master) and _layer_type_known(slave)
+    return tname in lreg._REGISTRY
+
+
+def _lint_layer_line(name: str, val: str, findings: List[Finding]
+                     ) -> Optional[Tuple[str, str]]:
+    """Validate one ``layer[..] = type[:name]`` line; returns the
+    (type, name) of the declared layer, or None when keys that follow
+    should not be linted (shared/unparsable layers)."""
+    from ..layers import registry as lreg
+    from ..nnet.netconfig import _LAYER_ARROW, _LAYER_PLUS
+    if _LAYER_PLUS.match(name) is None and _LAYER_ARROW.match(name) is None:
+        findings.append(Finding(
+            "error", name, "invalid layer declaration (expected "
+            "layer[+N], layer[+N:tag], or layer[in->out])"))
+        return None
+    if val.startswith("share"):
+        return None  # shared layer: params on it are a structural error
+    tname, _, lname = val.partition(":")
+    if not _layer_type_known(tname):
+        findings.append(Finding(
+            "error", name, f"unknown layer type {tname!r}",
+            suggestion=did_you_mean(tname, lreg.layer_type_names())))
+        return None
+    return (tname, lname)
+
+
+def _lint_layer_key(cur_layer: Tuple[str, str], name: str, val: str,
+                    findings: List[Finding]) -> None:
+    tname, lname = cur_layer
+    scope_name = f"layer:{tname}" + (f":{lname}" if lname else "")
+    if registry.layer_scope(tname) is None:
+        return  # unresolvable plugin surface: don't guess
+    specs = registry.layer_key_match(tname, name)
+    if specs:
+        _lint_value(specs, name, val, scope_name, findings)
+        return
+    if registry.known_anywhere(name):
+        findings.append(Finding(
+            "warn", name, f"not consumed by layer type {tname!r}; it "
+            "will be silently ignored here", scope=scope_name))
+        return
+    scope = registry.layer_scope(tname)
+    findings.append(Finding(
+        "error", name, "unknown config key",
+        suggestion=did_you_mean(
+            name, scope.names() or registry.global_scope().names()),
+        scope=scope_name))
+
+
+def _structural_findings(pairs: ConfigPairs) -> List[Finding]:
+    """Run the real NetConfig parser: undefined input nodes, duplicate
+    layer names, params on shared layers, malformed shapes."""
+    from ..nnet.netconfig import NetConfig
+    from ..utils.config import ConfigError
+    if not any(k.startswith("layer[") for k, _ in pairs):
+        return []  # no netconfig block (pred-from-checkpoint configs)
+    try:
+        NetConfig().configure(list(pairs))
+    except (ConfigError, AssertionError) as e:
+        return [Finding("error", "netconfig", f"net structure invalid: {e}")]
+    except ValueError as e:
+        return [Finding("error", "netconfig",
+                        f"net structure invalid: {e}")]
+    return []
+
+
+# ------------------------------------------------------ cross-key rules
+def _as_int(last: Dict[str, str], key: str, default: int = 0) -> int:
+    try:
+        return int(last.get(key, default))
+    except ValueError:
+        return default
+
+
+def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
+                     sections_seen: Dict[int, int],
+                     findings: List[Finding]) -> None:
+    last = dict(pairs)  # last occurrence wins, like sequential set_param
+    task = "train"
+    for k, v in pairs:
+        if k == "task" and v != "check":
+            task = v
+    add = findings.append
+
+    update_period = _as_int(last, "update_period", 1)
+    multi_step = _as_int(last, "multi_step", 0)
+    monitor = _as_int(last, "monitor", 0)
+    batch_split = _as_int(last, "batch_split", 1)
+    batch_size = _as_int(last, "batch_size", 0)
+
+    if last.get("dp_overlap") == "1":
+        if batch_split > 1 or _as_int(last, "remat", 0) > 0 \
+                or "pipe" in last.get("mesh", ""):
+            add(Finding("warn", "dp_overlap",
+                        "dp_overlap = 1 with batch_split/remat/pipe: these "
+                        "paths schedule their own backward, so the run will "
+                        "fall back to the implicit-psum step"))
+        if "dp_reduce_at" in last and last["dp_reduce_at"] == "apply" \
+                and update_period <= 1:
+            add(Finding("warn", "dp_reduce_at",
+                        "dp_reduce_at = apply has no effect without "
+                        "update_period > 1 (there is only one reduce per "
+                        "apply either way)"))
+    if monitor and multi_step > 1:
+        add(Finding("warn", "multi_step",
+                    "monitor = 1 forces per-batch dispatch; multi_step "
+                    f"= {multi_step} grouping will be disabled"))
+    if multi_step > 1 and update_period > 1:
+        add(Finding("warn", "multi_step",
+                    "multi_step grouping requires update_period = 1; "
+                    "the run will dispatch per batch"))
+    if "monitor_nan" in last and not monitor:
+        add(Finding("warn", "monitor_nan",
+                    "the NaN/inf loss guard is only checked when "
+                    "monitor = 1; monitor_nan has no effect here"))
+    if batch_split > 1 and batch_size and batch_size % batch_split:
+        add(Finding("error", "batch_split",
+                    f"batch_size = {batch_size} is not divisible by "
+                    f"batch_split = {batch_split}"))
+    pipe_mb = _as_int(last, "pipe_microbatch", 0)
+    if pipe_mb > 0 and batch_size and batch_size % pipe_mb:
+        add(Finding("error", "pipe_microbatch",
+                    f"batch_size = {batch_size} is not divisible by "
+                    f"pipe_microbatch = {pipe_mb}"))
+    if last.get("dtype") == "bfloat16" \
+            and last.get("pallas_ln", "1") not in ("0", "x") \
+            and any(t == "layernorm" or t.startswith("pairtest-")
+                    and "layernorm" in t for t in layer_types):
+        add(Finding("info", "pallas_ln",
+                    "bf16 + pallas_ln: the output-derived layernorm "
+                    "backward amplifies rounding for columns with "
+                    "|beta| >> |gamma| (doc/pallas_ln.md); pallas_ln = x "
+                    "is the input-saving escape hatch"))
+    if _as_int(last, "continue", 0) and \
+            last.get("model_in", "NULL") != "NULL":
+        add(Finding("warn", "model_in",
+                    "continue = 1 resumes from the newest snapshot; "
+                    "model_in is ignored"))
+    if task in ("train", "finetune") and sections_seen.get(1, 0) == 0:
+        add(Finding("warn", "data",
+                    f"task = {task} but the config has no 'data = ...' "
+                    "iterator section (fine for bench/netconfig-only "
+                    "configs; task = train will fail at init)"))
+    if task in ("pred", "pred_raw", "extract"):
+        if sections_seen.get(3, 0) == 0:
+            add(Finding("error", "pred",
+                        f"task = {task} requires a 'pred = <out>' "
+                        "iterator section"))
+        if last.get("model_in", "NULL") == "NULL":
+            add(Finding("error", "model_in",
+                        f"task = {task} requires model_in"))
+        if task == "extract" and not last.get("extract_node_name", ""):
+            add(Finding("error", "extract_node_name",
+                        "task = extract requires extract_node_name"))
+
+
+# ----------------------------------------------- strict_config reporting
+_reported: set = set()
+
+
+def report_ignored_layer_key(layer, name: str, val: str) -> None:
+    """``strict_config = 1`` hook (layers/base.py): a key reached the
+    base set_param unconsumed.  Silent when the layer type declares it
+    (subclasses that consume a key and still call super) or when any
+    subsystem declares it (globals are broadcast to every layer); warns
+    once per (type, key) otherwise."""
+    if name in _SECTION_HEADS or name in ("iter", "netconfig") \
+            or name.startswith("layer["):
+        return  # sectioning keys are consumed structurally, not by scopes
+    tname = layer.type_names[0] if layer.type_names else type(layer).__name__
+    if (tname, name) in _reported:
+        return
+    if registry.layer_key_match(tname, name):
+        return
+    if registry.layer_scope(tname) is None or registry.known_anywhere(name):
+        return
+    _reported.add((tname, name))
+    from ..monitor import log as mlog
+    scope = registry.layer_scope(tname)
+    sugg = did_you_mean(name, scope.names())
+    mlog.warn(
+        f"strict_config: layer {layer.name or tname!s} ({tname}) ignores "
+        f"unknown key {name!r}"
+        + (f" (did you mean {sugg!r}?)" if sugg else ""))

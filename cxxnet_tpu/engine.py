@@ -167,9 +167,18 @@ class _Options:
             setattr(self, name, val)
 
     def set(self, name: str, val: str) -> None:
-        assert name in _DEFS, f"unknown engine option {name}"
-        assert _valid(name, val), (
-            f"engine option {name} = {val}: expected {_expectation(name)}")
+        # ValueError, not assert: asserts vanish under ``python -O`` and a
+        # silently-accepted unknown option is exactly the bug class
+        # task=check exists for
+        if name not in _DEFS:
+            from .analysis.schema import did_you_mean
+            sugg = did_you_mean(name, _DEFS)
+            raise ValueError(
+                f"unknown engine option {name!r}"
+                + (f" (did you mean {sugg!r}?)" if sugg else ""))
+        if not _valid(name, val):
+            raise ValueError(
+                f"engine option {name} = {val}: expected {_expectation(name)}")
         setattr(self, name, val)
 
 
@@ -189,3 +198,22 @@ def is_engine_option(name: str) -> bool:
 
 def set_engine_option(name: str, val: str) -> None:
     opts.set(name, val)
+
+
+def key_specs():
+    """Engine options as lint KeySpecs (analysis/registry.py) — the value
+    validator is the same ``_valid`` the runtime enforces, so the lint
+    pass and ``set_engine_option`` can never disagree."""
+    from .analysis.schema import KeySpec
+
+    def make_check(name):
+        def check(val):
+            if not _valid(name, val):
+                return f"expected {_expectation(name)}"
+            return None
+        return check
+
+    return tuple(
+        KeySpec(name=name, kind="str", check=make_check(name),
+                help=f"engine option (env {env}, default {default!r})")
+        for name, (env, default, _) in _DEFS.items())

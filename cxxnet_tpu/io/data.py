@@ -51,6 +51,12 @@ class DataBatch:
 class IIterator:
     """Iterator interface (data.h:19-39)."""
 
+    # keys this stage's set_param consumes — harvested by the lint
+    # registry (analysis/registry.py); a name ending in "[*]" is a
+    # numbered-key template (extra_data_shape[0], ...).  Keep in sync
+    # with set_param.
+    config_keys: tuple = ()
+
     def set_param(self, name: str, val: str) -> None:
         pass
 

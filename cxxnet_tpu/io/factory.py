@@ -18,6 +18,34 @@ from .iter_proc import (AttachTxtIterator, AugmentIterator,
                         BatchAdaptIterator, DenseBufferIterator,
                         ThreadBufferIterator)
 
+#: ``iter = <name>`` -> the python stage classes that name instantiates,
+#: in wrap order.  The lint registry (analysis/registry.py) harvests each
+#: stage's ``config_keys`` from here, so the accepted-key set of an
+#: iterator section is derived from the same table the factory builds
+#: from.  ``imbin_native`` is listed lazily below (its import pulls
+#: ctypes/library loading).
+ITER_STAGES = {
+    "mnist": (MNISTIterator,),
+    "img": (BatchAdaptIterator, AugmentIterator, ImageIterator),
+    "imgbin": (BatchAdaptIterator, AugmentIterator, ImageBinIterator),
+    "imgbinx": (BatchAdaptIterator, AugmentIterator, ImageBinIterator),
+    "threadbuffer": (ThreadBufferIterator,),
+    "membuffer": (DenseBufferIterator,),
+    "attachtxt": (AttachTxtIterator,),
+}
+
+
+def iter_stage_classes(name: str):
+    """Stage classes for one ``iter =`` value, or None when unknown."""
+    if name == "imbin_native":
+        from .native import NativeImageBinIterator
+        return (NativeImageBinIterator,)
+    return ITER_STAGES.get(name)
+
+
+def iter_type_names():
+    return sorted(ITER_STAGES) + ["imbin_native", "end"]
+
 
 def create_iterator(cfg: List[Tuple[str, str]]) -> IIterator:
     it: IIterator = None

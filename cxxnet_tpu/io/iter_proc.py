@@ -18,6 +18,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..analysis.schema import K
 from .data import DataBatch, DataInst, IIterator
 from .device_prefetch import ProducerError, generation_put
 
@@ -34,6 +35,13 @@ class BatchAdaptIterator(IIterator):
     semantics without shape polymorphism).  ``test_skipread = 1`` returns
     the same batch without reading (I/O isolation benchmark mode, :72-74).
     """
+
+    config_keys = (
+        K("batch_size", "int", lo=1),
+        K("round_batch", "int", lo=0, hi=1),
+        K("test_skipread", "int", lo=0, hi=1),
+        K("label_width", "int", lo=1),
+    )
 
     def __init__(self, base: IIterator):
         self.base = base
@@ -220,6 +228,25 @@ class AugmentIterator(IIterator):
     random/fixed crop, mirror, mean subtraction (mean image file generated on
     first use, :171-198, or mean_value RGB), scale."""
 
+    config_keys = (
+        K("rotate", "float"), K("max_rotate_angle", "float", lo=0),
+        K("max_shear_ratio", "float", lo=0),
+        K("max_aspect_ratio", "float", lo=0),
+        K("min_crop_size", "int", lo=0),
+        K("max_crop_size", "int", lo=0),
+        K("rotate_list", "str", help="comma-separated angles"),
+        K("fill_value", "float"),
+        K("rand_crop", "int", lo=0, hi=1),
+        K("rand_mirror", "int", lo=0, hi=1),
+        K("mirror", "int", lo=0, hi=1),
+        K("input_shape", "str", help="c,y,x"),
+        K("image_mean", "path"), K("mean_value", "str"),
+        K("scale", "float"),
+        K("max_random_contrast", "float", lo=0),
+        K("max_random_illumination", "float", lo=0),
+        K("crop_y_start", "int", lo=0), K("crop_x_start", "int", lo=0),
+    )
+
     def __init__(self, base: IIterator):
         self.base = base
         self.rand_crop = 0
@@ -360,6 +387,8 @@ class ThreadBufferIterator(IIterator):
     before_first(), never a hang.
     """
 
+    config_keys = (K("buffer_size", "int", lo=1),)
+
     def __init__(self, base: IIterator, max_buffer: int = 4):
         self.base = base
         self.max_buffer = max_buffer
@@ -425,6 +454,8 @@ class DenseBufferIterator(IIterator):
     """Caches the first max_nbatch batches in RAM and loops over them
     (iter_mem_buffer-inl.hpp:16-76)."""
 
+    config_keys = (K("max_nbatch", "int", lo=1),)
+
     def __init__(self, base: IIterator):
         self.base = base
         self.max_nbatch = 0
@@ -470,6 +501,11 @@ class AttachTxtIterator(IIterator):
     ``batch.extra_data``, keyed by instance index
     (iter_attach_txt-inl.hpp:15-99).  File format: each line is
     ``inst_index v1 v2 ... vk``; shape from ``extra_shape[i] = c,y,x``."""
+
+    config_keys = (
+        K("path_attach_txt", "path"), K("path_txt", "path"),
+        K("extra_data_shape[*]", "str", help="c,y,x per side input"),
+    )
 
     def __init__(self, base: IIterator):
         self.base = base

@@ -23,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..analysis.schema import K
 from .data import DataBatch, IIterator
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -90,6 +91,26 @@ class NativeImageBinIterator(IIterator):
     round_batch/num_batch_padd handling happen in C++ (reference batch
     adapter semantics, iter_batch_proc-inl.hpp:89-106).
     """
+
+    # the native loader consumes the imgbin + augment + batch-adapt
+    # surface in C++ (the config text is forwarded wholesale); the
+    # declaration mirrors the python chain it replaces
+    config_keys = (
+        K("image_bin", "path"), K("path_imgbin", "path"),
+        K("image_list", "path"), K("path_imglst", "path"),
+        K("batch_size", "int", lo=1),
+        K("round_batch", "int", lo=0, hi=1),
+        K("label_width", "int", lo=1),
+        K("shuffle", "int", lo=0, hi=1),
+        K("silent", "int", lo=0, hi=1), K("seed_data", "int"),
+        K("input_shape", "str", help="c,y,x"),
+        K("image_mean", "path"), K("mean_value", "str"),
+        K("scale", "float"), K("output_u8", "int", lo=0, hi=1),
+        K("rand_crop", "int", lo=0, hi=1),
+        K("rand_mirror", "int", lo=0, hi=1),
+        K("mirror", "int", lo=0, hi=1),
+        K("decode_thread_num", "int", lo=0),
+    )
 
     def __init__(self):
         self._cfg = []

@@ -18,6 +18,7 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.schema import K
 from .base import ForwardContext, Layer, Params, Shape4
 from ..engine import opts
 
@@ -124,6 +125,7 @@ class XeluLayer(_UnaryLayer):
     """Leaky relu with divisor b: x>0 ? x : x/b (op.h:51-61; default b=5)."""
 
     type_names = ("xelu",)
+    extra_config_keys = (K("b", "float", help="leak divisor"),)
 
     def __init__(self):
         super().__init__()
@@ -149,6 +151,10 @@ class InsanityLayer(_UnaryLayer):
     """
 
     type_names = ("insanity",)
+    extra_config_keys = (
+        K("lb", "float"), K("ub", "float"),
+        K("calm_start", "int", lo=0), K("calm_end", "int", lo=0),
+    )
 
     def __init__(self):
         super().__init__()
@@ -197,6 +203,10 @@ class PReluLayer(_UnaryLayer):
     """
 
     type_names = ("prelu",)
+    extra_config_keys = (
+        K("init_slope", "float"), K("random_slope", "int", lo=0, hi=1),
+        K("random", "float"),
+    )
 
     def __init__(self):
         super().__init__()

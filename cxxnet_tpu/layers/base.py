@@ -30,7 +30,54 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.schema import K, KeySpec
+
 Shape4 = Tuple[int, int, int, int]  # (batch, channel, y, x)
+
+# ``strict_config = 1`` (global key, default off): route config keys that
+# every consumer silently drops through the lint reporter as warnings
+# instead of losing them — the reference rule ("components ignore keys
+# they don't know", doc/global.md) stays the default because globals are
+# legitimately broadcast to every subsystem.
+_STRICT_CONFIG = False
+
+
+def set_strict_config(flag: bool) -> None:
+    global _STRICT_CONFIG
+    _STRICT_CONFIG = bool(flag)
+    # fresh dedup window per toggle: a new net built under a new
+    # strict_config=1 must warn again for the same (type, key)
+    import sys
+    conflint = sys.modules.get("cxxnet_tpu.analysis.conflint")
+    if conflint is not None:
+        conflint._reported.clear()
+
+
+def strict_config_enabled() -> bool:
+    return _STRICT_CONFIG
+
+
+#: keys LayerParam.set_param consumes — shared by every layer; the common
+#: hyperparameter surface of ``src/layer/param.h``
+LAYER_PARAM_KEYS: Tuple[KeySpec, ...] = (
+    K("init_sigma", "float", help="gaussian init stddev"),
+    K("init_uniform", "float", help="uniform init bound (<=0 = xavier)"),
+    K("init_bias", "float"),
+    K("random_type", "enum",
+      choices=("gaussian", "uniform", "xavier", "kaiming")),
+    K("nhidden", "int", lo=1),
+    K("nchannel", "int", lo=1),
+    K("ngroup", "int", lo=1),
+    K("kernel_size", "int", lo=1),
+    K("kernel_height", "int", lo=1),
+    K("kernel_width", "int", lo=1),
+    K("stride", "int", lo=1),
+    K("pad", "int", lo=0),
+    K("pad_y", "int", lo=0),
+    K("pad_x", "int", lo=0),
+    K("no_bias", "int", lo=0, hi=1),
+    K("silent", "int", lo=0, hi=1),
+)
 
 
 class ShapeError(ValueError):
@@ -143,7 +190,10 @@ class LayerParam:
     no_bias: int = 0
     silent: int = 0
 
-    def set_param(self, name: str, val: str) -> None:
+    def set_param(self, name: str, val: str) -> bool:
+        """Consume one config key; returns True when the key was one of
+        the common layer hyperparameters (the lint registry declares the
+        same set as :data:`LAYER_PARAM_KEYS`)."""
         if name == "init_sigma":
             self.init_sigma = float(val)
         elif name == "init_uniform":
@@ -179,6 +229,9 @@ class LayerParam:
             self.no_bias = int(val)
         elif name == "silent":
             self.silent = int(val)
+        else:
+            return False
+        return True
 
     def rand_init_weight(self, key: jax.Array, shape: Sequence[int],
                          in_num: int, out_num: int,
@@ -230,6 +283,10 @@ class Layer:
     type_names: Tuple[str, ...] = ()
     # True for loss layers (self-loop + contributes a loss term)
     is_loss: bool = False
+    # keys this subclass's set_param consumes beyond LAYER_PARAM_KEYS —
+    # the declared-key registry (analysis/registry.py) harvests these;
+    # keep them in sync with the set_param branches
+    extra_config_keys: Tuple[KeySpec, ...] = ()
 
     def __init__(self) -> None:
         self.param = LayerParam()
@@ -237,8 +294,24 @@ class Layer:
 
     # -- configuration ----------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
-        """Consume a config key; unknown keys are ignored (reference rule)."""
-        self.param.set_param(name, val)
+        """Consume a config key; unknown keys are ignored (reference rule)
+        unless ``strict_config = 1`` routes them through the lint
+        reporter as warnings (keys declared by this layer type or known
+        anywhere in the global registry stay silent — globals are
+        broadcast to every layer)."""
+        consumed = self.param.set_param(name, val)
+        if not consumed and _STRICT_CONFIG:
+            from ..analysis.conflint import report_ignored_layer_key
+            report_ignored_layer_key(self, name, val)
+
+    @classmethod
+    def config_keys(cls) -> Tuple[KeySpec, ...]:
+        """Every key this layer type accepts: the common LayerParam set
+        plus each class's declared extras along the MRO."""
+        out = list(LAYER_PARAM_KEYS)
+        for klass in cls.__mro__:
+            out.extend(klass.__dict__.get("extra_config_keys", ()))
+        return tuple(out)
 
     # -- shapes -----------------------------------------------------------
     def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:

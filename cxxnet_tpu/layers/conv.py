@@ -17,6 +17,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from ..analysis.schema import K
 from ..ops import nn as N
 from .base import ForwardContext, Layer, Params, Shape4
 
@@ -30,6 +31,12 @@ class ConvolutionLayer(Layer):
     """
 
     type_names = ("conv",)
+    extra_config_keys = (
+        K("space_to_depth", "int", lo=0, hi=1,
+          help="lower a strided conv through space-to-depth"),
+        K("temp_col_max", "int",
+          help="accepted and ignored: XLA tiles conv scratch itself"),
+    )
 
     def __init__(self):
         super().__init__()
@@ -206,6 +213,9 @@ class InsanityPoolingLayer(_PoolingBase):
     """
 
     type_names = ("insanity_max_pooling",)
+    extra_config_keys = (
+        K("keep", "float", lo=0.0, hi=1.0, help="jitter keep probability"),
+    )
 
     def __init__(self):
         super().__init__()
@@ -237,6 +247,10 @@ class LRNLayer(Layer):
     """Cross-channel local response normalization (lrn_layer-inl.hpp:11-89)."""
 
     type_names = ("lrn",)
+    extra_config_keys = (
+        K("local_size", "int", lo=1), K("alpha", "float"),
+        K("beta", "float"), K("knorm", "float"),
+    )
 
     def __init__(self):
         super().__init__()

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.schema import K
 from .base import ForwardContext, Layer, Params, Shape4, as_mat
 
 
@@ -57,6 +58,10 @@ class FixConnectLayer(Layer):
     """
 
     type_names = ("fixconn",)
+    extra_config_keys = (
+        K("fixconn_weight", "path",
+          help="sparse projection table file"),
+    )
 
     def __init__(self):
         super().__init__()

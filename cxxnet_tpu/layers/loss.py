@@ -17,12 +17,17 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from ..analysis.schema import K
 from ..ops import nn as N
 from .base import ForwardContext, Layer, Params, Shape4, as_mat
 
 
 class LossLayerBase(Layer):
     is_loss = True
+    extra_config_keys = (
+        K("target", "str", help="label field this loss consumes"),
+        K("grad_scale", "float"),
+    )
 
     def __init__(self):
         super().__init__()

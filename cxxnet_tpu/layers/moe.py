@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis.schema import K
 from .base import ForwardContext, Layer, Shape4
 
 
@@ -61,6 +62,13 @@ def _expert_mesh(ctx: ForwardContext):
 
 class MoELayer(Layer):
     type_names = ("moe",)
+    extra_config_keys = (
+        K("num_expert", "int", lo=2),
+        K("capacity_factor", "float", lo=0.0),
+        K("moe_alpha", "float"),
+        K("moe_dispatch", "enum", choices=("auto", "dense", "sorted")),
+        K("router_jitter", "float", lo=0.0),
+    )
 
     def __init__(self):
         super().__init__()

@@ -11,6 +11,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from ..analysis.schema import K
 from ..ops import nn as N
 from .base import ForwardContext, Layer, Params, Shape4
 
@@ -29,6 +30,11 @@ class BatchNormLayer(Layer):
     """
 
     type_names = ("batch_norm",)
+    extra_config_keys = (
+        K("init_slope", "float"), K("eps", "float", lo=0.0),
+        K("moving_average", "int", lo=0, hi=1),
+        K("bn_momentum", "float", lo=0.0, hi=1.0),
+    )
 
     def __init__(self):
         super().__init__()
@@ -119,6 +125,10 @@ class DropoutLayer(Layer):
     threshold(uniform, pkeep) / pkeep at train, identity at eval."""
 
     type_names = ("dropout",)
+    extra_config_keys = (
+        K("threshold", "float", lo=0.0, hi=0.999,
+          help="drop probability (1 - pkeep)"),
+    )
 
     def __init__(self):
         super().__init__()

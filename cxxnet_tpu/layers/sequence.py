@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.schema import K
 from ..parallel import ring
 from .base import ForwardContext, Layer, Shape4
 from .loss import LossLayerBase
@@ -72,6 +73,10 @@ class EmbeddingLayer(Layer):
     """
 
     type_names = ("embedding",)
+    extra_config_keys = (
+        K("vocab_size", "int", lo=1),
+        K("pos_embed", "int", lo=0, hi=1),
+    )
 
     def __init__(self):
         super().__init__()
@@ -124,6 +129,7 @@ class LayerNormLayer(Layer):
     """
 
     type_names = ("layernorm",)
+    extra_config_keys = (K("eps", "float", lo=0.0),)
 
     def __init__(self):
         super().__init__()
@@ -237,6 +243,9 @@ class AttentionLayer(Layer):
     """
 
     type_names = ("attention",)
+    extra_config_keys = (
+        K("nhead", "int", lo=1), K("causal", "int", lo=0, hi=1),
+    )
 
     def __init__(self):
         super().__init__()

@@ -20,12 +20,45 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .analysis.schema import K
 from .io.device_prefetch import DevicePrefetcher, StagedGroup, item_h2d_sec
 from .io.factory import create_iterator, init_iterator
 from .monitor import log as mlog
 from .monitor.trace import ProfileWindow
 from .nnet.trainer import NetTrainer
 from .utils.config import parse_config_file, parse_keyval_args
+
+#: keys LearnTask.set_param consumes — the task half of the config
+#: surface (the trainer half is nnet/trainer.TRAINER_KEYS).  Harvested
+#: by analysis/registry.py; keep in sync with set_param below.
+TASK_KEYS = (
+    K("print_step", "int", lo=1),
+    K("continue", "int", lo=0, hi=1),
+    K("save_model", "int", lo=0),
+    K("start_counter", "int", lo=0),
+    K("model_in", "path"), K("model_dir", "path"),
+    K("num_round", "int", lo=0), K("max_round", "int", lo=0),
+    K("silent", "int", lo=0, hi=1),
+    K("task", "enum", choices=("train", "finetune", "pred", "pred_raw",
+                               "extract", "check")),
+    K("dev", "str"),
+    K("test_io", "int", lo=0, hi=1),
+    K("multi_step", "int", lo=0),
+    K("prefetch_device", "int", lo=0),
+    K("synth_device_data", "int", lo=0, hi=1),
+    K("extract_node_name", "str"),
+    K("eval_train", "int", lo=0, hi=1),
+    K("prof", "path"),
+    K("prof_start_step", "int", lo=-1),
+    K("prof_num_steps", "int", lo=0),
+    K("test_on_server", "int", lo=0, hi=1),
+    # the runtime deliberately tolerates unknown spellings (treated as
+    # binary, with a warning) — soft keeps the lint at warn severity
+    K("output_format", "enum", choices=("txt", "bin"), soft=True),
+    K("dist_coordinator", "str"),
+    K("dist_num_proc", "int", lo=1),
+    K("dist_proc_rank", "int", lo=0),
+)
 
 
 class LearnTask:
@@ -664,6 +697,40 @@ class LearnTask:
         for b in bad:
             mlog.warn(b)
 
+    def task_check(self) -> int:
+        """``task = check``: static config lint + traced-graph lint.
+
+        Runs in seconds with no device work and no data files: the
+        config lint walks the declared-key registry, the jaxpr lint
+        abstract-traces the configured step on CPU (skipped when the
+        config has no netconfig block, e.g. pred-from-checkpoint).
+        Exit code 1 iff any error-severity finding — a typo'd key fails
+        the run *before* a compile-and-train cycle is spent on it."""
+        from .analysis import run_check
+        path = getattr(self, "_conf_path", "")
+        findings, code = run_check(self.cfg, path=path, trace=True)
+        counts = {"error": 0, "warn": 0, "info": 0}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+            emit = mlog.result if f.severity in ("error", "warn") \
+                else mlog.info
+            emit("check: " + f.format())
+        mlog.result(
+            f"check: {path or '<config>'}: {counts['error']} error(s), "
+            f"{counts['warn']} warning(s), {counts['info']} info")
+        # `check` record to the JSONL metrics sink (doc/monitor.md) so
+        # CI lint results land in the same stream as train telemetry
+        from .monitor.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        for k, v in self.cfg:
+            if k == "metrics_sink":
+                reg.configure_sink(v)
+        reg.emit("check", config=path, n_error=counts["error"],
+                 n_warn=counts["warn"], n_info=counts["info"],
+                 findings=[f.to_dict() for f in findings])
+        reg.close()
+        return code
+
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
@@ -746,6 +813,10 @@ class LearnTask:
             self.set_param(k, v)
         for k, v in parse_keyval_args(argv[1:]):
             self.set_param(k, v)
+        self._conf_path = argv[0]
+        if self.task == "check":
+            # lint-only: no iterators, no device, no data files
+            return self.task_check()
         try:
             self.init()
             mlog.info("initializing end, start working")

@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import engine
+from ..analysis.schema import K
 from ..io.data import DataBatch
 from ..layers.base import ForwardContext, LabelInfo, as_mat
 from ..monitor import TrainingDiverged, log as mlog
@@ -47,6 +48,56 @@ from .net import Network
 from .netconfig import NetConfig
 
 Pytree = Any
+
+def _metric_check(val: str):
+    """Lint-time metric-name validation via the real factory."""
+    from ..utils.metric import create_metric
+    try:
+        create_metric(val)
+        return None
+    except ValueError as e:
+        return str(e)
+
+
+def _mesh_check(val: str):
+    try:
+        meshlib.MeshSpec.parse(val)
+        return None
+    except Exception as e:  # noqa: BLE001 — any parse failure is the finding
+        return f"invalid mesh spec: {e}"
+
+
+#: keys NetTrainer.set_param consumes (engine options declare themselves
+#: in engine.py; the metric[...] scoped spellings are pattern keys the
+#: lint pass handles structurally).  Harvested by analysis/registry.py —
+#: keep in sync with set_param below.
+TRAINER_KEYS = (
+    K("batch_size", "int", lo=1), K("update_period", "int", lo=1),
+    K("seed", "int"), K("dev", "str"),
+    K("dtype", "enum", choices=("float32", "bfloat16", "float16")),
+    K("mesh", "str", check=_mesh_check, help="axis:size[,axis:size...]"),
+    K("fullc_gather", "int", lo=0, hi=1),
+    K("pipe_microbatch", "int", lo=0),
+    K("pipe_schedule", "enum", choices=("gpipe", "1f1b")),
+    K("batch_split", "int", lo=1), K("remat", "int", lo=0),
+    K("scale", "float"), K("mean_value", "str"),
+    K("shard_opt_state", "int", lo=0, hi=1),
+    K("update_on_server", "int", lo=0, hi=1),
+    K("silent", "int", lo=0, hi=1),
+    K("monitor", "int", lo=0, hi=1),
+    K("monitor_interval", "int", lo=1),
+    K("monitor_nan", "enum", choices=("warn", "fatal", "off")),
+    K("metrics_sink", "str", help="jsonl:<path> or none"),
+    K("eval_train", "int", lo=0, hi=1), K("eval_group", "int", lo=1),
+    K("input_s2d", "int", lo=0, hi=1), K("print_step", "int", lo=1),
+    K("metric", "str", check=_metric_check,
+      help="error/rmse/logloss/rec@n, repeatable"),
+    K("metric[*]", "str", check=_metric_check,
+      help="scoped metric[field] / metric[field,node]"),
+    K("strict_config", "int", lo=0, hi=1,
+      help="route silently-ignored config keys through the lint "
+           "reporter as warnings"),
+)
 
 
 class NetTrainer:
@@ -188,6 +239,11 @@ class NetTrainer:
             self.input_s2d = int(val)
         elif name == "print_step":
             self.print_step = int(val)
+        elif name == "strict_config":
+            # default off (behavior-preserving): layers report — rather
+            # than silently drop — keys no subsystem declares
+            from ..layers import base as layer_base
+            layer_base.set_strict_config(bool(int(val)))
         elif name.startswith("metric"):
             # metric[label,node] = m | metric[label] = m | metric = m
             import re

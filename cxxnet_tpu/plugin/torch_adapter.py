@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.schema import K
 from ..layers.base import ForwardContext, Layer, Params, Shape4
 from ..layers.registry import create_layer
 
@@ -95,6 +96,9 @@ class TorchLayer(Layer):
     """``layer[...] = torch`` with ``op = <name>`` (caffe adapter analogue)."""
 
     type_names = ("torch",)
+    extra_config_keys = (
+        K("op", "str", help="mirrored native op name"),
+    )
 
     def __init__(self) -> None:
         super().__init__()

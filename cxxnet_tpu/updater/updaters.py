@@ -24,7 +24,33 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.schema import K
+
 Params = Any
+
+#: keys UpdaterHyper.set_param consumes (analysis/registry.py harvests
+#: these; the lint pass additionally accepts the reference's tag-scoped
+#: spellings ``wmat:<key>`` / ``bias:<key>``).  Keep in sync with the
+#: set_param branches below.
+HYPER_KEYS = (
+    K("lr", "float", lo=0.0), K("eta", "float", lo=0.0),
+    K("wd", "float"), K("momentum", "float"),
+    K("clip_gradient", "float", lo=0.0),
+    K("momentum_schedule", "int", lo=0, hi=1),
+    K("base_momentum", "float"), K("final_momentum", "float"),
+    K("saturation_epoch", "int", lo=0),
+    K("beta1", "float"), K("beta2", "float"),
+    K("lr:schedule", "enum",
+      choices=("constant", "expdecay", "polydecay", "factor")),
+    K("lr:gamma", "float"), K("lr:alpha", "float"),
+    K("lr:step", "int", lo=1), K("lr:factor", "float"),
+    K("lr:minimum_lr", "float"), K("lr:start_epoch", "int", lo=0),
+    K("eta:schedule", "enum",
+      choices=("constant", "expdecay", "polydecay", "factor")),
+    K("eta:gamma", "float"), K("eta:alpha", "float"),
+    K("eta:step", "int", lo=1), K("eta:factor", "float"),
+    K("eta:minimum_lr", "float"), K("eta:start_epoch", "int", lo=0),
+)
 
 
 @dataclasses.dataclass

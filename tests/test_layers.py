@@ -545,8 +545,9 @@ def test_engine_options_config_keys():
         # one winner per window: each 2x2 window holds a single 1.0
         assert np.asarray(d_sas).sum() == 4.0
         assert (np.asarray(d_sas) > 0).sum() == 4
-        # invalid values are rejected
-        with pytest.raises(AssertionError):
+        # invalid values are rejected — ValueError since ISSUE 5 (asserts
+        # vanish under python -O)
+        with pytest.raises(ValueError):
             set_engine_option("pool_bwd", "bogus")
     finally:
         set_engine_option("pool_bwd", old)

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""graftlint: static config + traced-graph lint for cxxnet_tpu configs.
+
+The standalone CLI twin of ``task = check`` (doc/check.md): lint one or
+more ``.conf`` files against the declared-key registry and — unless
+``--no-trace`` — abstract-trace each configured train step on CPU and
+lint the jaxpr (closure-captured constants, f64 promotions, weak-typed
+state leaves, dp-reduction escapes).  No device work, no data files.
+
+    python tools/graftlint.py [--json] [--no-trace] conf [conf ...]
+
+Exit status: 1 iff any config produced an error-severity finding.
+``--json`` prints one machine-readable object (schema in doc/check.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="static config + traced-graph lint (task=check twin)")
+    ap.add_argument("configs", nargs="+", help=".conf files to lint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (doc/check.md schema)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="config lint only; skip the jaxpr pass")
+    args = ap.parse_args()
+
+    from cxxnet_tpu.analysis import run_check
+    from cxxnet_tpu.utils.config import ConfigError, parse_config_file
+
+    worst = 0
+    report = []
+    for path in args.configs:
+        try:
+            pairs = parse_config_file(path)
+        except (OSError, ConfigError) as e:
+            findings, code = [], 1
+            entry = {"config": path, "parse_error": str(e),
+                     "n_error": 1, "n_warn": 0, "n_info": 0, "findings": []}
+            if not args.as_json:
+                print(f"{path}: parse error: {e}")
+            report.append(entry)
+            worst = max(worst, code)
+            continue
+        findings, code = run_check(pairs, path=path,
+                                   trace=not args.no_trace)
+        worst = max(worst, code)
+        counts = {"error": 0, "warn": 0, "info": 0}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        report.append({"config": path, "n_error": counts["error"],
+                       "n_warn": counts["warn"], "n_info": counts["info"],
+                       "findings": [f.to_dict() for f in findings]})
+        if not args.as_json:
+            print(f"{path}: {counts['error']} error(s), "
+                  f"{counts['warn']} warning(s), {counts['info']} info")
+            for f in findings:
+                print("  " + f.format())
+    if args.as_json:
+        json.dump({"kind": "graftlint", "exit": worst, "configs": report},
+                  sys.stdout, indent=2)
+        print()
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

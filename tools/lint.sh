@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Static-analysis gate (runs before any device work, no data files):
+#   1. graftlint over every shipped example config — zero error-severity
+#      findings required (the key registry and the configs must agree;
+#      tests/test_analysis.py mirrors this as the golden guard);
+#   2. the pytest collection guard — import breaks must not hide behind
+#      tier-1's --continue-on-collection-errors.
+# Companion to tools/tier1.sh (the runtime gate); see doc/check.md.
+cd "$(dirname "$0")/.." || exit 1
+set -e
+env JAX_PLATFORMS=cpu python tools/graftlint.py example/*/*.conf
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only \
+    -p no:cacheprovider >/dev/null
+echo "lint OK"

@@ -1,5 +1,8 @@
 #!/usr/bin/env bash
 # Tier-1 verify: the ROADMAP.md command, verbatim.  Run from anywhere;
 # prints DOTS_PASSED=<n> and exits with pytest's status.
+# The static gate (tools/lint.sh: graftlint over example/ + the pytest
+# collection guard) catches config typos and import breaks in seconds —
+# run it first; it needs no device and no data files (doc/check.md).
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
