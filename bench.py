@@ -434,23 +434,58 @@ silent = 1
 """
 
 
+#: collective-kind -> mesh-axis attribution for the explicit overlap
+#: schedule (parallel/overlap.py): bucketed data reductions lower as
+#: all-reduce / reduce-scatter, model-axis weight gathers as all-gather,
+#: expert dispatch as all-to-all.  Implicit (GSPMD) runs are attributed
+#: by the same table — approximate there, exact for overlap-on runs.
+COMM_KIND_AXIS = {
+    "all-reduce": "data", "reduce-scatter": "data",
+    "all-gather": "model", "all-to-all": "expert",
+    "collective-permute": "seq", "collective-broadcast": "other",
+}
+
+
+def _comm_axis_shares(rep) -> dict:
+    """Per-axis comm share from a comm_report: kind ms -> axis seconds /
+    device seconds."""
+    dev_sec = rep.get("device_sec", 0.0)
+    out = {}
+    for kind, ms in rep.get("comm_by_kind", {}).items():
+        ax = COMM_KIND_AXIS.get(kind, "other")
+        out[ax] = out.get(ax, 0.0) + ms / 1e3
+    if dev_sec:
+        return {ax: round(sec / dev_sec, 4) for ax, sec in out.items()}
+    return {ax: 0.0 for ax in out}
+
+
 def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
-              make_data, scan_len, extra=(), bucket_mb="4"):
-    """One (model, device-count, overlap-mode) measurement: trainer on a
-    ``data:n`` mesh, ``update_many`` dispatches timed double-buffered,
-    one traced dispatch for the comm/compute split.  Returns the point
-    dict for the --dp-scaling payload."""
+              make_data, scan_len, extra=(), bucket_mb="4",
+              mesh_str=None):
+    """One (model, mesh, overlap-mode) measurement: trainer on the given
+    mesh (default the pure ``data:n`` axis), ``update_many`` dispatches
+    timed double-buffered, one traced dispatch for the comm/compute
+    split.  Returns the point dict for the --dp-scaling /
+    --mesh-scaling payloads.  The batch scales with the DATA axis only
+    (model/seq/expert axes divide the per-example work, not the
+    batch)."""
     import shutil
 
     import jax
     from __graft_entry__ import _make_trainer
     from cxxnet_tpu.monitor.trace import comm_report
-    batch = per_chip_batch * n
+    from cxxnet_tpu.parallel.mesh import MeshSpec
+    mesh_str = mesh_str or f"data:{n}"
+    spec = MeshSpec.parse(mesh_str)
+    assert spec.size == n, (mesh_str, n)
+    batch = per_chip_batch * spec.axis_size("data")
+    mesh_extra = [("fullc_gather", "1")] \
+        if spec.axis_size("model") > 1 else []
     t = _make_trainer(
         net_conf, batch, f"{dev}:0-{n - 1}",
-        extra=[("mesh", f"data:{n}"), ("dp_overlap", "1" if overlap else "0"),
+        extra=[("mesh", mesh_str), ("dp_overlap", "1" if overlap else "0"),
                ("dp_bucket_mb", bucket_mb), ("eval_train", "0")]
-        + list(extra))
+        + mesh_extra + list(extra))
     datas, labels = make_data(scan_len, batch, data_shape)
     t.start_round(1)
     np.asarray(t.update_many(datas, labels))  # warmup / compile
@@ -467,7 +502,8 @@ def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
     np.asarray(pending)
     dt = sorted(ms)[1]
     per_chip = batch / dt / n
-    point = {"devices": n, "examples_per_sec_per_chip": round(per_chip, 1),
+    point = {"devices": n, "mesh": mesh_str,
+             "examples_per_sec_per_chip": round(per_chip, 1),
              "step_sec": round(dt, 5)}
     # comm/compute split from a traced dispatch (the number the
     # reference only claimed qualitatively; collective classification in
@@ -487,12 +523,14 @@ def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
             compute_share=round(max(1.0 - rep["comm_share"], 0.0), 4),
             overlap_frac=rep["overlap_frac"],
             comm_sec=rep["comm_sec"],
+            comm_share_per_axis=_comm_axis_shares(rep),
             comm_attributed=bool(rep["comm_sec"] or rep["device_sec"]))
     except Exception as e:  # tracing must never break the metric
         print(f"bench: dp-scaling trace failed (n={n}): {e}",
               file=sys.stderr)
         point.update(comm_share=0.0, compute_share=1.0, overlap_frac=0.0,
-                     comm_sec=0.0, comm_attributed=False)
+                     comm_sec=0.0, comm_share_per_axis={},
+                     comm_attributed=False)
     del t, datas, labels, pending
     import gc
     gc.collect()
@@ -550,7 +588,6 @@ def bench_dp_scaling(argv=None) -> dict:
             jax.config.update("jax_platforms", "cpu")
         except RuntimeError:
             pass
-    import jax.numpy as jnp
     n_avail = len(jax.devices())
     requested = counts
     counts = [n for n in counts if n <= n_avail]
@@ -562,44 +599,7 @@ def bench_dp_scaling(argv=None) -> dict:
     tiny = args.get("tiny", "0") == "1"
     bucket_mb = args.get("dp_bucket_mb", "0.05" if tiny else "4")
     models = args.get("models", "alexnet,transformer").split(",")
-    f32 = dev == "cpu"
-
-    def conv_data(scan_len, batch, shape):
-        rnd = np.random.RandomState(0)
-        datas = jnp.asarray(rnd.rand(scan_len, batch, *shape)
-                            .astype(np.float32))
-        labels = jnp.asarray(rnd.randint(
-            0, 10, (scan_len, batch, 1)).astype(np.float32))
-        return (datas if f32 else datas.astype(jnp.bfloat16)), labels
-
-    def tf_data(scan_len, batch, shape):
-        vocab, seq = shape
-        rnd = np.random.RandomState(0)
-        toks = rnd.randint(0, vocab, (scan_len, batch, 1, 1, seq))
-        labels = np.roll(toks.reshape(scan_len, batch, seq), -1, axis=-1)
-        return (jnp.asarray(toks.astype(np.float32)),
-                jnp.asarray(labels.astype(np.float32)))
-
-    def model_spec(name):
-        from cxxnet_tpu.models import transformer
-        from __graft_entry__ import ALEXNET_NET
-        if name == "alexnet":
-            if tiny:
-                return (DP_SCALING_TINY, int(args.get("alexnet_batch", 32)),
-                        (3, 16, 16), conv_data, 2, ())
-            return (ALEXNET_NET, int(args.get("alexnet_batch", 256)),
-                    (3, 227, 227), conv_data, 4,
-                    () if f32 else (("dtype", "bfloat16"),))
-        assert name == "transformer", name
-        vocab, seq, dim, nl = (256, 64, 32, 1) if tiny else \
-            (8192, 4096, 2048, 12)
-        net = transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nl,
-                          nhead=max(dim // 128, 2))
-        extra = [("updater", "adam")]
-        if not f32:
-            extra.append(("dtype", "bfloat16"))
-        return (net, int(args.get("tf_batch", 2 if tiny else 1)),
-                (vocab, seq), tf_data, 2, tuple(extra))
+    model_spec, _ = _dp_model_table(args, dev, tiny)
 
     # engine options are process-global: each point sets dp_* through its
     # trainer's config; restore afterwards so later benches in this
@@ -642,18 +642,275 @@ def bench_dp_scaling(argv=None) -> dict:
     }
 
 
-def main() -> None:
-    if "--dp-scaling" in sys.argv[1:]:
-        payload = bench_dp_scaling(
-            [a for a in sys.argv[1:] if a != "--dp-scaling"])
+def bench_mesh_scaling(argv=None) -> dict:
+    """``--mesh-scaling``: the general form of ``--dp-scaling`` — named
+    meshes instead of pure device counts.  Each point trains the
+    flagship config(s) on one mesh (``data:N[,model:M]``; model axes
+    shard fullc/moe weights via NamedSharding) with the explicit
+    overlapped step on vs off, and reports per-chip throughput, scaling
+    efficiency vs the FIRST listed mesh, and trace-attributed comm
+    share PER AXIS (``comm_share_per_axis``: all-reduce/reduce-scatter
+    -> data, all-gather -> model, all-to-all -> expert — exact for
+    overlap-on runs, where the schedule places every collective).
+
+    ``key=value`` overrides: ``dev`` (default cpu), ``meshes`` as a
+    semicolon list (default ``data:1;data:2;data:4;data:4,model:2``
+    clipped to visible devices), ``models`` (alexnet,transformer),
+    ``tiny=1`` CPU-sized stand-ins, ``alexnet_batch``/``tf_batch``
+    per-chip batch, ``dp_bucket_mb``."""
+    import os
+    args = dict(a.split("=", 1) for a in (argv or []) if "=" in a)
+    dev = args.get("dev", "cpu")
+    from cxxnet_tpu.parallel.mesh import MeshSpec
+    mesh_strs = [m for m in args.get(
+        "meshes", "data:1;data:2;data:4;data:4,model:2").split(";") if m]
+    specs = [MeshSpec.parse(m) for m in mesh_strs]
+    if dev == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(s.size for s in specs)}").strip()
+    import jax
+    if dev == "cpu":
         try:
-            emit_bench_record(payload)
-        except Exception as e:  # the sink must never break the payload
-            print(f"bench: metrics sink failed: {e}", file=sys.stderr)
-        print(json.dumps(payload))
-        return
-    if "--io-ab" in sys.argv[1:]:
-        payload = bench_io_ab([a for a in sys.argv[1:] if a != "--io-ab"])
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    n_avail = len(jax.devices())
+    requested = list(mesh_strs)
+    keep = [(m, s) for m, s in zip(mesh_strs, specs) if s.size <= n_avail]
+    assert keep, (
+        f"--mesh-scaling: none of meshes={requested} fit the {n_avail} "
+        f"visible {dev} device(s)")
+    mesh_strs = [m for m, _ in keep]
+    specs = [s for _, s in keep]
+    tiny = args.get("tiny", "0") == "1"
+    bucket_mb = args.get("dp_bucket_mb", "0.05" if tiny else "4")
+    models = args.get("models", "alexnet").split(",")
+    model_spec, _counts = _dp_model_table(args, dev, tiny)
+
+    from cxxnet_tpu.engine import opts as eng_opts, set_engine_option
+    saved_opts = {k: getattr(eng_opts, k)
+                  for k in ("dp_overlap", "dp_bucket_mb")}
+    out_models = {}
+    try:
+        for name in models:
+            net, per_chip, shape, make_data, scan_len, extra = \
+                model_spec(name)
+            points = []
+            for m, spec in zip(mesh_strs, specs):
+                row = {"mesh": m, "devices": spec.size}
+                for tag, ov in (("overlap_on", True),
+                                ("overlap_off", False)):
+                    row[tag] = _dp_point(
+                        net, per_chip, dev, spec.size, ov,
+                        data_shape=shape, make_data=make_data,
+                        scan_len=scan_len, extra=extra,
+                        bucket_mb=bucket_mb, mesh_str=m)
+                points.append(row)
+            base = {tag: points[0][tag]["examples_per_sec_per_chip"]
+                    for tag in ("overlap_on", "overlap_off")}
+            for row in points:
+                for tag in ("overlap_on", "overlap_off"):
+                    row[tag]["scaling_efficiency"] = round(
+                        row[tag]["examples_per_sec_per_chip"]
+                        / max(base[tag], 1e-9), 3)
+            out_models[name] = {"per_chip_batch": per_chip,
+                                "points": points}
+            last = points[-1]
+            print(f"bench: mesh-scaling {name} {last['mesh']} "
+                  f"{last['overlap_on']['examples_per_sec_per_chip']:.1f}"
+                  f"/chip (eff "
+                  f"{last['overlap_on']['scaling_efficiency']:.2f}) "
+                  "overlap-on, comm/axis "
+                  f"{last['overlap_on']['comm_share_per_axis']}",
+                  file=sys.stderr)
+    finally:
+        for k, v in saved_opts.items():
+            set_engine_option(k, v)
+    head = models[0]
+    last = out_models[head]["points"][-1]["overlap_on"]
+    return {
+        "metric": "mesh_scaling_examples_per_sec_per_chip",
+        "value": last["examples_per_sec_per_chip"],
+        "unit": "examples/sec/chip",
+        "meshes": mesh_strs,
+        "efficiency_baseline_mesh": mesh_strs[0],
+        "scaling_efficiency": last["scaling_efficiency"],
+        "comm_share": last["comm_share"],
+        "comm_share_per_axis": last["comm_share_per_axis"],
+        "models": out_models,
+    }
+
+
+def _dp_model_table(args, dev, tiny):
+    """Shared flagship table for --dp-scaling / --mesh-scaling: returns
+    ``(model_spec, default_counts)`` where ``model_spec(name)`` yields
+    ``(net_conf, per_chip_batch, data_shape, make_data, scan_len,
+    extra)``."""
+    import jax.numpy as jnp
+    f32 = dev == "cpu"
+
+    def conv_data(scan_len, batch, shape):
+        rnd = np.random.RandomState(0)
+        datas = jnp.asarray(rnd.rand(scan_len, batch, *shape)
+                            .astype(np.float32))
+        labels = jnp.asarray(rnd.randint(
+            0, 10, (scan_len, batch, 1)).astype(np.float32))
+        return (datas if f32 else datas.astype(jnp.bfloat16)), labels
+
+    def tf_data(scan_len, batch, shape):
+        vocab, seq = shape
+        rnd = np.random.RandomState(0)
+        toks = rnd.randint(0, vocab, (scan_len, batch, 1, 1, seq))
+        labels = np.roll(toks.reshape(scan_len, batch, seq), -1, axis=-1)
+        return (jnp.asarray(toks.astype(np.float32)),
+                jnp.asarray(labels.astype(np.float32)))
+
+    def model_spec(name):
+        from cxxnet_tpu.models import transformer
+        from __graft_entry__ import ALEXNET_NET
+        if name == "alexnet":
+            if tiny:
+                return (DP_SCALING_TINY, int(args.get("alexnet_batch", 32)),
+                        (3, 16, 16), conv_data, 2, ())
+            return (ALEXNET_NET, int(args.get("alexnet_batch", 256)),
+                    (3, 227, 227), conv_data, 4,
+                    () if f32 else (("dtype", "bfloat16"),))
+        assert name == "transformer", name
+        vocab, seq, dim, nl = (256, 64, 32, 1) if tiny else \
+            (8192, 4096, 2048, 12)
+        net = transformer(vocab=vocab, seq=seq, dim=dim, nlayer=nl,
+                          nhead=max(dim // 128, 2))
+        extra = [("updater", "adam")]
+        if not f32:
+            extra.append(("dtype", "bfloat16"))
+        return (net, int(args.get("tf_batch", 2 if tiny else 1)),
+                (vocab, seq), tf_data, 2, tuple(extra))
+
+    return model_spec, [1, 2, 4, 8]
+
+
+OPT_AB_ARMS = {
+    # arm -> engine/config pairs on top of the flagship transformer
+    # (the owed BENCH_r06 session: fused_update and pallas_ln A/Bs,
+    # same session, same data — see BASELINE.md round 6)
+    "base": (("fused_update", "0"), ("pallas_ln", "1")),
+    "fused": (("fused_update", "1"), ("pallas_ln", "1")),
+    "ln_x": (("fused_update", "0"), ("pallas_ln", "x")),
+    "ln_off": (("fused_update", "0"), ("pallas_ln", "0")),
+}
+
+
+def bench_opt_ab(argv=None) -> dict:
+    """``--opt-ab``: the one-command fused_update / pallas_ln A/B.
+
+    Trains the transformer flagship once per arm (engine options set
+    through each trainer's own config, process-global hygiene restored
+    afterwards) and reports wall ms/step (median of 3 double-buffered
+    dispatches) plus the trace-attributed device ms/step per arm, and
+    the base/arm speedups.  On TPU this IS the owed BENCH_r06 protocol:
+
+        python bench.py --opt-ab dev=tpu
+
+    ``key=value`` overrides: ``dev`` (default tpu), ``tiny=1``
+    (CPU-sized smoke), ``arms`` (comma list from
+    base/fused/ln_x/ln_off), ``batch``, ``scan_len``."""
+    args = dict(a.split("=", 1) for a in (argv or []) if "=" in a)
+    dev = args.get("dev", "tpu")
+    tiny = args.get("tiny", "0") == "1"
+    arms = [a for a in args.get("arms", "base,fused,ln_x,ln_off")
+            .split(",") if a]
+    for a in arms:
+        assert a in OPT_AB_ARMS, f"--opt-ab: unknown arm {a!r}"
+    import jax
+    if dev == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    from cxxnet_tpu.engine import _DEFS, opts as eng_opts, \
+        set_engine_option
+    from __graft_entry__ import _make_trainer
+    # the ONE flagship definition all bench modes share
+    # (_dp_model_table): --opt-ab must A/B the same transformer
+    # --dp-scaling/--mesh-scaling report, or BENCH_r06 comparisons lie
+    model_spec, _ = _dp_model_table(args, dev, tiny)
+    net, _per_chip, shape, make_data, _sl, tbl_extra = \
+        model_spec("transformer")
+    batch = int(args.get("batch", "2" if tiny else "4"))
+    scan_len = int(args.get("scan_len", "2" if tiny else "4"))
+    extra = list(tbl_extra) + [("eval_train", "0"), ("silent", "1")]
+    toks, labels = make_data(scan_len, batch, shape)
+    saved = {k: getattr(eng_opts, k) for k in _DEFS}
+    results = {}
+    try:
+        for arm in arms:
+            t = _make_trainer(net, batch, dev,
+                              extra=extra + list(OPT_AB_ARMS[arm]))
+            t.start_round(1)
+            np.asarray(t.update_many(toks, labels))  # warmup / compile
+            ms = []
+            pending = t.update_many(toks, labels)
+            t_last = time.perf_counter()
+            for _ in range(3):
+                nxt = t.update_many(toks, labels)
+                np.asarray(pending)
+                now = time.perf_counter()
+                ms.append((now - t_last) / scan_len * 1e3)
+                t_last = now
+                pending = nxt
+            np.asarray(pending)
+            entry = {"step_ms": round(sorted(ms)[1], 3),
+                     "opts": dict(OPT_AB_ARMS[arm])}
+            try:
+                dev_ms = _traced_device_step_ms(
+                    t, toks, labels, scan_len, "/tmp/bench_opt_ab")
+                entry["device_step_ms"] = round(dev_ms, 3)
+            except Exception as e:  # tracing must never break the A/B
+                print(f"bench: opt-ab trace failed ({arm}): {e}",
+                      file=sys.stderr)
+            results[arm] = entry
+            print(f"bench: opt-ab {arm} {entry['step_ms']:.2f} ms/step"
+                  + (f" ({entry['device_step_ms']:.2f} device)"
+                     if "device_step_ms" in entry else ""),
+                  file=sys.stderr)
+            import gc
+            del t, pending
+            gc.collect()
+    finally:
+        for k, v in saved.items():
+            set_engine_option(k, v)
+    base_ms = results.get("base", {}).get("step_ms", 0.0)
+    payload = {
+        "metric": "opt_ab_step_ms",
+        "value": base_ms,
+        "unit": "ms/step",
+        "arms": results,
+    }
+    for arm, entry in results.items():
+        if arm != "base" and base_ms:
+            payload[f"speedup_{arm}"] = round(
+                base_ms / max(entry["step_ms"], 1e-9), 3)
+    return payload
+
+
+#: --flag -> mode function; each takes the remaining argv and returns
+#: the one-line JSON payload (main() owns the sink mirror + print)
+BENCH_MODES = {
+    "--mesh-scaling": bench_mesh_scaling,
+    "--opt-ab": bench_opt_ab,
+    "--dp-scaling": bench_dp_scaling,
+    "--io-ab": bench_io_ab,
+}
+
+
+def main() -> None:
+    for flag, mode in BENCH_MODES.items():
+        if flag not in sys.argv[1:]:
+            continue
+        payload = mode([a for a in sys.argv[1:] if a != flag])
         try:
             emit_bench_record(payload)
         except Exception as e:  # the sink must never break the payload

@@ -38,6 +38,17 @@ def run_check(cfg, path: str = "", trace: bool = True
     return findings, (1 if n_err else 0)
 
 
+def _ensure_host_devices(n: int) -> None:
+    """Best-effort: ask XLA's host platform for >= ``n`` CPU devices so a
+    mesh config can trace (parallel/mesh.ensure_host_platform_devices).
+    Only effective before the first backend initialization (graftlint.py
+    sets the flag at process start; under pytest the conftest already
+    forces 8) — callers must still check ``len(jax.devices())``
+    afterwards and skip gracefully."""
+    from ..parallel.mesh import ensure_host_platform_devices
+    ensure_host_platform_devices(max(n, 8))
+
+
 def _trace_findings(cfg) -> List[Finding]:
     """Build the configured trainer on CPU and lint its traced step.
     Build failures become findings instead of crashes: a config whose net
@@ -61,8 +72,31 @@ def _trace_findings(cfg) -> List[Finding]:
                 net.set_param(k, v)
             # no device work: abstract tracing on the host platform.
             # "cpu" wins over the config's dev= because set_param assigns
-            # directly; the build chatter (net description) is lint noise
-            net.set_param("dev", "cpu")
+            # directly; the build chatter (net description) is lint noise.
+            # A mesh config needs its axis product in CPU devices — force
+            # the host platform count (no-op once a backend initialized)
+            # and skip the trace rather than erroring when short
+            need = net.mesh_spec.size if net.mesh_spec is not None else 1
+            if need > 1:
+                _ensure_host_devices(need)
+                import jax
+                try:
+                    jax.config.update("jax_platforms", "cpu")
+                except RuntimeError:
+                    pass  # backends already initialized
+                try:
+                    n_vis = len(jax.devices("cpu"))
+                except RuntimeError:
+                    n_vis = len(jax.devices())
+                if n_vis < need:
+                    return [F(
+                        "info", "mesh",
+                        f"traced-graph lint skipped: mesh needs {need} "
+                        f"devices, {n_vis} visible on the host platform "
+                        "(config lint above still ran)", scope="jaxpr")]
+                net.set_param("dev", f"cpu:0-{need - 1}")
+            else:
+                net.set_param("dev", "cpu")
             net.set_param("silent", "1")
             net.init_model()
         except (ConfigError, AssertionError, ValueError, KeyError) as e:

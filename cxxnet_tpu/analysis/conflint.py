@@ -264,10 +264,9 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
     batch_size = _as_int(last, "batch_size", 0)
 
     if last.get("dp_overlap") == "1":
-        if batch_split > 1 or _as_int(last, "remat", 0) > 0 \
-                or "pipe" in last.get("mesh", ""):
+        if batch_split > 1 or _as_int(last, "remat", 0) > 0:
             add(Finding("warn", "dp_overlap",
-                        "dp_overlap = 1 with batch_split/remat/pipe: these "
+                        "dp_overlap = 1 with batch_split/remat: these "
                         "paths schedule their own backward, so the run will "
                         "fall back to the implicit-psum step"))
         if "dp_reduce_at" in last and last["dp_reduce_at"] == "apply" \
@@ -276,6 +275,7 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                         "dp_reduce_at = apply has no effect without "
                         "update_period > 1 (there is only one reduce per "
                         "apply either way)"))
+    _mesh_rules(last, layer_types, update_period, batch_size, add)
     if monitor and multi_step > 1:
         add(Finding("warn", "multi_step",
                     "monitor = 1 forces per-batch dispatch; multi_step "
@@ -327,6 +327,78 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
         if task == "extract" and not last.get("extract_node_name", ""):
             add(Finding("error", "extract_node_name",
                         "task = extract requires extract_node_name"))
+
+
+def _mesh_rules(last: Dict[str, str], layer_types: List[str],
+                update_period: int, batch_size: int, add) -> None:
+    """Cross-key rules for the first-class ``mesh`` key: axis product vs
+    the device selection, batch divisibility by the data axis, the
+    dp_overlap x mesh combinations (surfaced at check time instead of as
+    the trainer's trace-time warn-once fallback), and a dead model axis.
+    Unknown axis NAMES are value errors handled by the ``mesh`` KeySpec
+    check (MeshSpec.parse with did-you-mean), so a spec that fails to
+    parse is skipped here — the error is already reported."""
+    mesh_str = last.get("mesh", "")
+    if not mesh_str:
+        return
+    from ..parallel.mesh import MeshSpec, parse_device_spec
+    try:
+        axes = MeshSpec.parse(mesh_str).axes
+    except ValueError:
+        return
+    total = 1
+    for v in axes.values():
+        total *= v
+    dev = last.get("dev", "")
+    ids = None
+    if dev:
+        try:
+            ids = parse_device_spec(dev)["ids"]
+        except (ValueError, IndexError):
+            ids = None  # malformed dev: its own KeySpec's problem
+    if ids is not None and len(ids) != total:
+        add(Finding("error", "mesh",
+                    f"mesh = {mesh_str} needs {total} device(s) (axis "
+                    f"product) but dev = {dev} selects {len(ids)}"))
+    ndata = axes.get("data", 1)
+    if batch_size and ndata > 1 and batch_size % ndata:
+        add(Finding("error", "mesh",
+                    f"batch_size = {batch_size} is not divisible by the "
+                    f"data axis ({ndata}); the batch shards over it"))
+    if axes.get("model", 1) > 1 and last.get("fullc_gather", "0") != "1" \
+            and "moe" not in layer_types:
+        add(Finding("info", "mesh",
+                    "the model axis shards nothing here (fullc_gather = 0 "
+                    "and no moe layer): model-axis devices replicate "
+                    "work; set fullc_gather = 1 to shard fullc weights"))
+    if last.get("dp_overlap") != "1":
+        return
+    extra_ax = [a for a, s in axes.items()
+                if a not in ("data", "model") and s > 1]
+    if extra_ax:
+        add(Finding("warn", "dp_overlap",
+                    f"dp_overlap = 1 with mesh axes {'/'.join(extra_ax)}: "
+                    "ring-attention/expert/pipeline collectives are "
+                    "GSPMD-placed, so the run will fall back to the "
+                    "implicit-psum step"))
+    elif ndata < 2:
+        add(Finding("warn", "dp_overlap",
+                    f"dp_overlap = 1 but mesh = {mesh_str} has no data "
+                    "axis wider than 1; there is nothing to reduce and "
+                    "the run falls back to the implicit step"))
+    elif axes.get("model", 1) > 1 and "moe" in layer_types:
+        add(Finding("warn", "dp_overlap",
+                    "dp_overlap = 1 with a moe layer on a model mesh "
+                    "axis: the model axis hosts the experts and their "
+                    "dispatch/combine all-to-alls are GSPMD-placed, so "
+                    "the run will fall back to the implicit-psum step"))
+    elif axes.get("model", 1) > 1 \
+            and last.get("dp_reduce_at", "apply") == "apply" \
+            and update_period > 1:
+        add(Finding("info", "dp_reduce_at",
+                    "dp_reduce_at = apply is pure-DP; the model mesh "
+                    "axis reduces every micro-step instead "
+                    "(dp_reduce_at = step semantics)"))
 
 
 # ----------------------------------------------- strict_config reporting

@@ -38,6 +38,19 @@ one mid-run does not retrace already-compiled steps.
 | pool_relu_reorder | 1 (default), 0       | move relu after max pool (and  |
 |             |                            | defer conv bias through it) —  |
 |             |                            | gradient-equivalent a.e.       |
+| pool_relu_fuse | 0 (default), 1          | fuse the deferred relu's       |
+|             |                            | backward into the multi-row    |
+|             |                            | Pallas pool-backward kernel    |
+|             |                            | (mask epilogue on the shared   |
+|             |                            | _mp_mr_plan tile plan) where   |
+|             |                            | the hwcn kernel takes the      |
+|             |                            | shape — implies the all-ties   |
+|             |                            | backward for those pools, like |
+|             |                            | pool_bwd = auto.  Attacks the  |
+|             |                            | GoogLeNet SAS+relu cluster     |
+|             |                            | (~15 ms measured vs ~5 modeled |
+|             |                            | , BASELINE.md round 5); opt-in |
+|             |                            | until a TPU session A/Bs it    |
 | conv_sibling_fuse | 0 (default), 1       | run same-input same-geometry   |
 |             |                            | convs (inception 1x1 reduces)  |
 |             |                            | as one fused conv + slices     |
@@ -126,6 +139,7 @@ _DEFS = {
                    ("band", "bandconv", "hwcn", "1", "0")),
     "relu_vjp": ("CXXNET_RELU_VJP", "out", ("out", "xla")),
     "pool_relu_reorder": ("CXXNET_POOL_RELU_REORDER", "1", ("1", "0")),
+    "pool_relu_fuse": ("CXXNET_POOL_RELU_FUSE", "0", ("1", "0")),
     "conv_sibling_fuse": ("CXXNET_CONV_SIBLING_FUSE", "0", ("1", "0")),
     "concat_virtual": ("CXXNET_CONCAT_VIRTUAL", "0", ("1", "0")),
     "flash_attn": ("CXXNET_NO_FLASH_ATTN", "1", ("1", "0")),

@@ -23,6 +23,21 @@ class FullConnectLayer(Layer):
 
     type_names = ("fullc",)
 
+    @staticmethod
+    def model_shard_spec(tag: str, shape, model_size: int):
+        """Sharding policy for a ``model`` mesh axis (the trainer's
+        ``_make_shardings`` consults the layer so the policy lives next
+        to the math it shards): the big GEMM weight splits its output
+        rows over ``model`` — the ``fullc_gather`` tensor-parallel mode,
+        where GSPMD inserts the activation all-gathers, and the
+        dp_overlap path gathers the weight shards at segment entry.
+        Returns a PartitionSpec or None (replicate)."""
+        from jax.sharding import PartitionSpec as P
+        if tag == "wmat" and len(shape) == 2 \
+                and shape[0] % model_size == 0:
+            return P("model", None)
+        return None
+
     def infer_shapes(self, in_shapes: List[Shape4]) -> List[Shape4]:
         assert len(in_shapes) == 1, "fullc: 1-1 connection only"
         n, c, h, w = in_shapes[0]

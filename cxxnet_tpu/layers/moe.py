@@ -52,16 +52,43 @@ from ..analysis.schema import K
 from .base import ForwardContext, Layer, Shape4
 
 
-def _expert_mesh(ctx: ForwardContext):
-    mesh = getattr(ctx, "mesh", None)
-    if mesh is not None and "expert" in mesh.axis_names \
-            and mesh.shape["expert"] > 1:
-        return mesh
+def expert_host_axis(mesh) -> str | None:
+    """The mesh axis that hosts the per-expert dimension, or ``None``.
+    A dedicated ``expert`` axis wins; otherwise the ``model`` axis hosts
+    the experts (``mesh = data:N,model:M`` is the first-class multi-axis
+    config — expert weights shard over ``model`` at rest via
+    NamedSharding, and the dispatch/combine einsums become GSPMD
+    all-to-alls over it exactly as they would over ``expert``).  The
+    single source of truth for both the trainer's rest shardings
+    (``_make_shardings``) and the runtime constraints below."""
+    if mesh is not None:
+        for ax in ("expert", "model"):
+            if ax in mesh.axis_names and mesh.shape[ax] > 1:
+                return ax
     return None
+
+
+def _expert_axis(ctx: ForwardContext):
+    """``(mesh, axis)`` for this forward, or ``(None, None)``."""
+    mesh = getattr(ctx, "mesh", None)
+    ax = expert_host_axis(mesh)
+    return (mesh, ax) if ax is not None else (None, None)
 
 
 class MoELayer(Layer):
     type_names = ("moe",)
+
+    @staticmethod
+    def shard_spec(tag: str, shape, axis: str, size: int):
+        """Rest sharding over mesh axis ``axis`` (``expert``, or
+        ``model`` when no expert axis exists — see :func:`_expert_axis`):
+        every per-expert tensor splits its leading (expert) dim; the
+        gate stays replicated (every token scores every expert).
+        Returns a PartitionSpec or None (replicate)."""
+        from jax.sharding import PartitionSpec as P
+        if tag != "gate" and len(shape) >= 1 and shape[0] % size == 0:
+            return P(axis, *([None] * (len(shape) - 1)))
+        return None
     extra_config_keys = (
         K("num_expert", "int", lo=2),
         K("capacity_factor", "float", lo=0.0),
@@ -198,11 +225,14 @@ class MoELayer(Layer):
         expert = jnp.argmax(probs, axis=-1)              # (t,)
         gate_p = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
 
-        mesh = _expert_mesh(ctx)
+        mesh, eaxis = _expert_axis(ctx)
 
         def eshard(a, spec):
             if mesh is None:
                 return a
+            # call sites spell the canonical "expert" axis; rewrite to
+            # whichever axis actually hosts the experts on this mesh
+            spec = P(eaxis, *tuple(spec)[1:])
             return jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh, spec))
 

@@ -279,6 +279,17 @@ class NetTrainer:
         load_model (continue/finetune must come up on the same global mesh
         as a fresh start; the reference restarts its distributed launcher
         in every worker, cxxnet_main.cpp:135-157)."""
+        # a CPU device range (dev = cpu:0-3, the mesh examples/tests) needs
+        # the host platform to EMULATE that many devices; the flag must
+        # land before the first backend touch — including process_count()
+        # below — so this runs first (no-op once a backend initialized)
+        spec = meshlib.parse_device_spec(self.dev)
+        if spec["platform"] == "cpu":
+            need = max(
+                [self.mesh_spec.size if self.mesh_spec is not None else 1]
+                + [i + 1 for i in (spec["ids"] or [])])
+            if need > 1:
+                meshlib.ensure_host_platform_devices(need)
         if jax.process_count() > 1:
             # multi-host: the mesh must span the global device set; local
             # id selection (dev = tpu:0-3) only makes sense single-host
@@ -353,11 +364,22 @@ class NetTrainer:
         self._dp_plan_state = None
         self._dp_warned: set = set()
         self._overlap_step_cache: Dict[Tuple[bool, bool], Any] = {}
-        self._overlap_defer = (
+        defer_wanted = (
             self.update_period > 1 and not self.monitor
             and self.netcfg.extra_data_num == 0
             and engine.opts.dp_reduce_at == "apply"
             and self._dp_overlap_active())
+        # the deferred local accumulator carries a leading device axis
+        # sharded over "data" with FULL param shapes — pure-DP only;
+        # model meshes reduce every micro-step (dp_reduce_at = step
+        # semantics, which is also the bitwise-parity mode)
+        self._overlap_defer = defer_wanted and not self._dp_model_axis()
+        if defer_wanted and not self._overlap_defer \
+                and "defer_model" not in self._dp_warned:
+            self._dp_warned.add("defer_model")
+            mlog.warn("dp_reduce_at = apply is pure-DP; the model mesh "
+                      "axis reduces every micro-step instead "
+                      "(dp_reduce_at = step semantics)")
         self._train_step = self._build_train_step()
         self._multi_step_cache: Dict[int, Any] = {}
         self._eval_step_cache = {}
@@ -379,24 +401,34 @@ class NetTrainer:
         mesh = self.mesh
         self.batch_shard = meshlib.batch_sharding(mesh)
         self.repl = meshlib.replicated(mesh)
-        from ..layers.moe import MoELayer
+        from ..layers.fullc import FullConnectLayer
+        from ..layers.moe import MoELayer, expert_host_axis
         moe_keys = {conn.param_key for conn in self.net.connections
                     if isinstance(conn.layer, MoELayer)}
+        # the axis hosting the per-expert dimension ("expert", else
+        # "model"): the SAME helper the runtime constraints consult, so
+        # rest placement and with_sharding_constraint can never diverge
+        expert_axis = expert_host_axis(mesh)
 
         def param_spec(pkey: str, tag: str, shape) -> NamedSharding:
-            if (self.fullc_gather and "model" in mesh.axis_names
-                    and tag == "wmat" and len(shape) == 2
-                    and shape[0] % mesh.shape["model"] == 0):
-                return NamedSharding(mesh, P("model", None))
-            if (pkey in moe_keys and "expert" in mesh.axis_names
-                    and tag != "gate"
-                    and shape[0] % mesh.shape["expert"] == 0):
+            # sharding policy lives next to the layer math it shards
+            # (fullc.model_shard_spec / moe.shard_spec); the trainer only
+            # picks the axis and gates the tensor-parallel mode
+            if self.fullc_gather and "model" in mesh.axis_names \
+                    and pkey not in moe_keys:
+                sp = FullConnectLayer.model_shard_spec(
+                    tag, shape, mesh.shape["model"])
+                if sp is not None:
+                    return NamedSharding(mesh, sp)
+            if pkey in moe_keys and expert_axis is not None:
                 # expert-parallel AT REST too: each device keeps only its
                 # experts' weights (and, via opt_shardings following
                 # param leading dims below, their optimizer state) —
                 # the memory benefit of EP, not just the compute
-                return NamedSharding(
-                    mesh, P("expert", *([None] * (len(shape) - 1))))
+                sp = MoELayer.shard_spec(tag, shape, expert_axis,
+                                         mesh.shape[expert_axis])
+                if sp is not None:
+                    return NamedSharding(mesh, sp)
             return self.repl
 
         self.param_shardings = {
@@ -445,6 +477,13 @@ class NetTrainer:
                             and p.size >= 2 ** 14)
             self.dp_zero_grads = jax.tree.map(
                 zero_pred, self.params, self.param_shardings)
+        # leaves sharded over the "model" axis on their LEADING dim: the
+        # dp-overlap step all-gathers exactly these at their segment's
+        # forward entry and takes their gradients back as shards
+        # (parallel/overlap.py model-axis composition)
+        self.dp_model_sharded = jax.tree.map(
+            lambda p, s: bool(len(s.spec) > 0 and s.spec[0] == "model"),
+            self.params, self.param_shardings)
         self.buffer_shardings = jax.tree.map(lambda _: self.repl, self.buffers)
         # place initial state
         self.params = jax.device_put(self.params, self.param_shardings)
@@ -915,6 +954,13 @@ class NetTrainer:
                                    body_loss=body_loss)
 
     # ----------------------------------------------- dp overlap (explicit)
+    def _dp_model_axis(self) -> bool:
+        """True when the mesh carries a model axis wider than 1 (the
+        overlap schedule then composes weight-shard all-gathers with the
+        bucketed data reductions — parallel/overlap.py)."""
+        return "model" in self.mesh.axis_names \
+            and self.mesh.shape["model"] > 1
+
     def _dp_warn_once(self, reason: str) -> None:
         if reason not in self._dp_warned:
             self._dp_warned.add(reason)
@@ -933,13 +979,17 @@ class NetTrainer:
             if plan is not None:
                 sizes = [sum(overlap._group_bytes(self.params[k])
                              for k in ks) for ks in plan.stage_keys]
+                n_gather = sum(bool(l) for l in jax.tree.leaves(
+                    self.dp_model_sharded))
                 mlog.info(
                     "dp_overlap: %d buckets (KiB per bucket: %s), "
-                    "reduce_dtype=%s, reduce_at=%s" % (
+                    "reduce_dtype=%s, reduce_at=%s%s" % (
                         len(plan.stages),
                         ",".join(str(s // 1024) for s in sizes),
                         engine.opts.dp_reduce_dtype,
-                        engine.opts.dp_reduce_at))
+                        engine.opts.dp_reduce_at,
+                        f", model-axis gathers={n_gather} leaves"
+                        if self._dp_model_axis() and n_gather else ""))
         return self._dp_plan_state[0]
 
     def _dp_overlap_active(self) -> bool:
@@ -953,10 +1003,31 @@ class NetTrainer:
         if "data" not in mesh.axis_names or mesh.shape["data"] < 2:
             self._dp_warn_once("mesh has no data axis wider than 1")
             return False
-        if any(mesh.shape[a] > 1 for a in mesh.axis_names if a != "data"):
+        # a "model" axis composes (weight shards gather at segment entry,
+        # parallel/overlap.py); seq/expert/pipe collectives are placed by
+        # GSPMD/shard_map machinery the sliced-vjp walk can't host
+        extra_axes = [a for a in mesh.axis_names
+                      if a not in ("data", "model") and mesh.shape[a] > 1]
+        if extra_axes:
             self._dp_warn_once(
-                "mesh has non-data axes (overlap is the pure-DP path)")
+                f"mesh axes {'/'.join(extra_axes)} need GSPMD-placed "
+                "collectives (ring attention / expert all-to-all / "
+                "pipeline)")
             return False
+        if self._dp_model_axis():
+            from ..layers.moe import MoELayer
+            if any(isinstance(c.layer, MoELayer)
+                   for c in self.net.connections):
+                # the model axis HOSTS the experts (moe.expert_host_axis):
+                # the implicit step runs expert-parallel dense dispatch
+                # with GSPMD all-to-alls, which the sliced-vjp walk can't
+                # place — and the explicit step's mesh-less forward would
+                # silently resolve moe_dispatch=auto to the sorted path
+                # (differently-associated backward, no bitwise parity)
+                self._dp_warn_once(
+                    "the model axis hosts MoE experts; dispatch/combine "
+                    "all-to-alls are GSPMD-placed")
+                return False
         if self._pipelined or self.remat or self.batch_split > 1:
             self._dp_warn_once("pipe/remat/batch_split paths schedule "
                                "their own backward")

@@ -413,13 +413,23 @@ def _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x):
     return _max_pool_raw(x, ksize_y, ksize_x, stride, pad_y, pad_x)
 
 
+def _hwcn_pool_ok(x, ksize_y: int, ksize_x: int, stride: int,
+                  pad_y: int, pad_x: int) -> bool:
+    """Shapes the native-layout (H, W, C, N) Pallas pool kernels serve
+    on TPU — the ONE eligibility gate shared by ``max_pool2d`` and the
+    relu-fused ``max_pool2d_relu``, so the two entry points can never
+    accept different shapes (which would flip a pool between all-ties
+    and SAS gradient semantics depending on the call site)."""
+    from .pallas_kernels import max_pool_hwcn_supported
+    return (pad_y == 0 and pad_x == 0 and ksize_y == ksize_x
+            and jax.default_backend() == "tpu"
+            and x.shape[0] % 128 == 0
+            and max_pool_hwcn_supported(x.shape, stride))
+
+
 def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
                pad_y: int = 0, pad_x: int = 0) -> jnp.ndarray:
-    from .pallas_kernels import max_pool_hwcn_supported
-    hwcn_ok = (pad_y == 0 and pad_x == 0 and ksize_y == ksize_x
-               and jax.default_backend() == "tpu"
-               and x.shape[0] % 128 == 0
-               and max_pool_hwcn_supported(x.shape, stride))
+    hwcn_ok = _hwcn_pool_ok(x, ksize_y, ksize_x, stride, pad_y, pad_x)
     # "auto": Pallas all-ties where the hwcn kernel takes the shape, SAS
     # elsewhere (measured ~equal to pure SAS on the GoogLeNet stage pools,
     # BASELINE.md round 5).  Gradient SEMANTICS then vary per pool
@@ -449,6 +459,26 @@ def max_pool2d(x: jnp.ndarray, ksize_y: int, ksize_x: int, stride: int,
         yt = _pool_nchw_as_chwn(xt, ksize_y, ksize_x, stride, pad_y, pad_x)
         return jnp.transpose(yt, (3, 0, 1, 2))
     return _max_pool_dispatch(x, ksize_y, ksize_x, stride, pad_y, pad_x)
+
+
+def max_pool2d_relu(x: jnp.ndarray, ksize_y: int, ksize_x: int,
+                    stride: int, pad_y: int = 0, pad_x: int = 0
+                    ) -> jnp.ndarray:
+    """``relu(max_pool2d(x))`` — the deferred-relu pool (the
+    ``pool_relu_reorder`` peephole's execution form).  With
+    ``pool_relu_fuse = 1`` and a shape the hwcn Pallas kernel takes,
+    the relu backward fuses into the multi-row all-ties unpool kernel
+    (``pallas_kernels.max_pool_relu_hwcn``) — the separate relu-bwd
+    pass over the pooled tensor disappears.  Fusing implies the
+    all-ties backward for that pool (like ``pool_bwd = auto``); the
+    unfused fallback keeps today's exact pair: the configured pool
+    backward followed by the ``relu_vjp``-configured relu."""
+    if opts.pool_relu_fuse == "1" \
+            and _hwcn_pool_ok(x, ksize_y, ksize_x, stride, pad_y, pad_x):
+        from .pallas_kernels import max_pool_relu_hwcn
+        return max_pool_relu_hwcn(x, ksize_y, stride)
+    from ..layers.activation import apply_relu
+    return apply_relu(max_pool2d(x, ksize_y, ksize_x, stride, pad_y, pad_x))
 
 
 def _pool_nchw_as_chwn(xt, ksize_y, ksize_x, stride, pad_y, pad_x):

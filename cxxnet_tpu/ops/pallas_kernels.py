@@ -513,7 +513,7 @@ def _mp_interleave(acc, a_row, wpad, wq):
     return wide[:a_row.shape[0]]
 
 
-def _mp_hwcn_bwd_kernel(*refs, k, s, ow, wpad, oh, h_in):
+def _mp_hwcn_bwd_kernel(*refs, k, s, ow, wpad, oh, h_in, relu_mask=False):
     ncand = -(-k // s)  # output rows touching one input row
     x_ref = refs[0]
     p_refs = refs[1:1 + ncand]
@@ -533,14 +533,23 @@ def _mp_hwcn_bwd_kernel(*refs, k, s, ow, wpad, oh, h_in):
         i_tap = h - s * jnp.clip(r, 0, oh - 1)
         valid_r = (r >= 0) & (r < oh) & (i_tap >= 0) & (i_tap < k)
         dv = jnp.where(valid_r, dv, 0.0)
+        if relu_mask:
+            # fused relu backward: pv is the PRE-relu pool output and
+            # relu(pv) > 0 iff pv > 0, so masking dv here is exactly
+            # where(out > 0, dy, 0) — no separate relu-bwd HBM pass
+            dv = jnp.where(pv > 0, dv, 0.0)
         acc = _mp_col_place(ph, pv, dv, k, s, ow, wq, acc)
     dx_ref[0] = _mp_interleave(acc, a, wpad, wq).astype(dx_ref.dtype)
 
 
-def _mp_hwcn_bwd_kernel_mr(*refs, k, s, ow, wpad, oh, h_in, hb, nref):
+def _mp_hwcn_bwd_kernel_mr(*refs, k, s, ow, wpad, oh, h_in, hb, nref,
+                           relu_mask=False):
     """Multi-row backward: hb input rows per program (hb % s == 0, so the
     candidate-row offsets are static per in-block row), p/dp supplied as
-    ``nref`` one-row refs starting at the block's first candidate row."""
+    ``nref`` one-row refs starting at the block's first candidate row.
+    ``relu_mask`` fuses the deferred-relu backward (pool_relu_fuse): each
+    candidate's incoming gradient is zeroed where the pre-relu pool
+    output is <= 0, in-register, on the same (hb, cb) tile plan."""
     ncand = -(-k // s)
     x_ref = refs[0]
     p_refs = refs[1:1 + nref]
@@ -568,6 +577,9 @@ def _mp_hwcn_bwd_kernel_mr(*refs, k, s, ow, wpad, oh, h_in, hb, nref):
             dv = dp_refs[ref_i][0].astype(jnp.float32)
             valid = (r_abs >= 0) & (r_abs < oh) & (h0 + j < h_in)
             dv = jnp.where(valid, dv, 0.0)
+            if relu_mask:
+                # see _mp_hwcn_bwd_kernel: relu'(pool) folded in-register
+                dv = jnp.where(pv > 0, dv, 0.0)
             acc = _mp_col_place(ph, pv, dv, k, s, ow, wq, acc)
         rows.append(_mp_interleave(acc, a, wpad, wq))
     dx_ref[...] = jnp.stack(rows, axis=0).astype(dx_ref.dtype)
@@ -604,7 +616,7 @@ def _mp_hwcn_fwd(xt, k, s, interpret):
     )(*([xt] * k))
 
 
-def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
+def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None, relu_mask=False):
     h, w, c, n = xt.shape
     oh, ow = pt.shape[0], pt.shape[1]
     wpad = max(-(-w // s), (k - 1) // s + ow) * s  # see _mp_hwcn_fwd
@@ -632,7 +644,7 @@ def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
                    for i in range(nref)]
         kern = functools.partial(_mp_hwcn_bwd_kernel_mr, k=k, s=s, ow=ow,
                                  wpad=wpad, oh=oh, h_in=h, hb=hb,
-                                 nref=nref)
+                                 nref=nref, relu_mask=relu_mask)
         return pl.pallas_call(
             kern,
             grid=(c // cb, n // nb, -(-h // hb)),
@@ -655,7 +667,8 @@ def _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret, hb=None):
     p_specs = [pl.BlockSpec((1, ow, cb, nb), cand_imap(i), **kw)
                for i in range(ncand)]
     kern = functools.partial(_mp_hwcn_bwd_kernel, k=k, s=s, ow=ow,
-                             wpad=wpad, oh=oh, h_in=h)
+                             wpad=wpad, oh=oh, h_in=h,
+                             relu_mask=relu_mask)
     return pl.pallas_call(
         kern,
         grid=(c // cb, n // nb, h),
@@ -689,6 +702,39 @@ def _mp_bwd_res(k, s, res, g):
 
 
 max_pool_hwcn.defvjp(_mp_fwd_res, _mp_bwd_res)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def max_pool_relu_hwcn(x: jnp.ndarray, k: int, s: int) -> jnp.ndarray:
+    """``relu(max_pool(x))`` with the relu backward FUSED into the
+    multi-row all-ties unpool kernel (engine option ``pool_relu_fuse``):
+    the deferred-relu mask ``pool_out > 0`` zeroes each candidate's
+    incoming gradient in-register on the shared :func:`_mp_mr_plan`
+    tile plan, so the stride^2-sized relu-bwd read-modify-write pass
+    over the pooled tensor — the SAS+relu cluster's second half —
+    disappears.  Residuals are identical to :func:`max_pool_hwcn`
+    (``(xt, pt)`` with ``pt`` the PRE-relu pool output; the relu needs
+    no extra buffer because ``relu'(pt) = pt > 0``)."""
+    out, _ = _mpr_fwd_res(x, k, s)
+    return out
+
+
+def _mpr_fwd_res(x, k, s):
+    xt = jnp.transpose(x, (2, 3, 1, 0))
+    pt = _mp_hwcn_fwd(xt, k, s, interpret=not _on_tpu())
+    y = jnp.maximum(jnp.transpose(pt, (3, 2, 0, 1)), 0)
+    return y, (xt, pt)
+
+
+def _mpr_bwd_res(k, s, res, g):
+    xt, pt = res
+    dpt = jnp.transpose(g, (2, 3, 1, 0))
+    dxt = _mp_hwcn_bwd(xt, pt, dpt, k, s, interpret=not _on_tpu(),
+                       relu_mask=True)
+    return (jnp.transpose(dxt, (3, 2, 0, 1)),)
+
+
+max_pool_relu_hwcn.defvjp(_mpr_fwd_res, _mpr_bwd_res)
 
 
 # --------------------------------------------------------------------------

@@ -43,6 +43,20 @@ def parse_device_spec(dev: str) -> Dict:
     return {"platform": platform, "ids": ids}
 
 
+def ensure_host_platform_devices(n: int) -> None:
+    """Best-effort: ask XLA's host platform for ``n`` CPU devices (a
+    ``dev = cpu:0-3`` + ``mesh = data:2,model:2`` config needs them).
+    Only effective BEFORE the first backend initialization — call it
+    before anything touches ``jax.devices()``/``jax.process_count()``;
+    afterwards it is a harmless no-op and callers must check the visible
+    count themselves."""
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
 def select_devices(dev: str) -> List[jax.Device]:
     spec = parse_device_spec(dev)
     platform = spec["platform"]
@@ -70,6 +84,14 @@ def select_devices(dev: str) -> List[jax.Device]:
     return [devices[i] for i in spec["ids"]]
 
 
+#: mesh axis names the framework gives semantics to: ``data`` shards the
+#: batch, ``model`` shards fullc/moe weights (tensor/weight parallelism),
+#: ``seq`` ring attention, ``expert`` MoE dispatch, ``pipe`` pipeline
+#: stages.  ``mesh=`` is a first-class config key; an unknown axis name
+#: would silently shard nothing, so parse rejects it with a suggestion.
+KNOWN_AXES = ("data", "model", "seq", "expert", "pipe")
+
+
 @dataclasses.dataclass
 class MeshSpec:
     """Named mesh axes, e.g. {"data": 4, "model": 2}."""
@@ -78,11 +100,36 @@ class MeshSpec:
 
     @classmethod
     def parse(cls, s: str) -> "MeshSpec":
-        """Parse ``mesh = data:4,model:2`` config syntax."""
+        """Parse ``mesh = data:4,model:2`` config syntax.  Raises
+        ``ValueError`` on unknown/duplicate axis names or non-positive
+        sizes (surfaced as a config-lint error by graftlint and as an
+        init-time error by the trainer)."""
         axes: Dict[str, int] = {}
         for part in s.split(","):
-            name, size = part.split(":")
-            axes[name.strip()] = int(size)
+            name, sep, size = part.partition(":")
+            name = name.strip()
+            if not sep:
+                raise ValueError(
+                    f"mesh axis {part.strip()!r}: expected name:size")
+            if name not in KNOWN_AXES:
+                from ..analysis.schema import did_you_mean
+                sugg = did_you_mean(name, KNOWN_AXES)
+                raise ValueError(
+                    f"unknown mesh axis {name!r} (axes with semantics: "
+                    f"{', '.join(KNOWN_AXES)})"
+                    + (f"; did you mean {sugg!r}?" if sugg else ""))
+            if name in axes:
+                raise ValueError(f"duplicate mesh axis {name!r}")
+            try:
+                n = int(size)
+            except ValueError:
+                raise ValueError(
+                    f"mesh axis {name}: size {size.strip()!r} is not an "
+                    "integer") from None
+            if n < 1:
+                raise ValueError(f"mesh axis {name}: size must be >= 1, "
+                                 f"got {n}")
+            axes[name] = n
         return cls(axes)
 
     @property
@@ -91,6 +138,10 @@ class MeshSpec:
         for v in self.axes.values():
             n *= v
         return n
+
+    def axis_size(self, name: str) -> int:
+        """Size of ``name`` (1 when the axis is absent)."""
+        return self.axes.get(name, 1)
 
 
 def build_mesh(devices: Sequence[jax.Device],

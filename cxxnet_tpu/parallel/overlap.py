@@ -24,6 +24,17 @@ parameter servers (Li et al., OSDI'14) do:
   moment that segment's vjp returns — so bucket L's reduction is
   data-independent of segment L-1's backward and XLA's latency-hiding
   scheduler overlaps the two, exactly the async_updater schedule;
+* on a multi-axis mesh (``mesh = data:N,model:M``) the schedule composes
+  with the model axis instead of bailing: parameters sharded over
+  ``model`` at rest (fullc/moe NamedShardings) enter the shard_map as
+  shards, each segment **all-gathers its own model-sharded leaves at its
+  forward entry** (the gathers interleave with forward compute, placed
+  by the same segment walk that places the reductions), backward slices
+  the cotangent back to the shard for free (compute is replicated across
+  ``model``, so every replica's cotangent is identical and each keeps
+  the slice its shard owns), and the bucketed data-axis ``psum`` fires
+  exactly as in the pure-DP case — the lowered step carries the model
+  all-gathers composed with the per-bucket data all-reduces;
 * ``dp_reduce_dtype = bf16`` casts gradients to bf16 for the wire and
   back for the f32 master apply (half the comm volume);
 * with ``update_period > 1`` and ``dp_reduce_at = apply`` (the default)
@@ -46,6 +57,7 @@ differ from the implicit path's partitioned key stream.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -58,6 +70,41 @@ from .pipeline import shard_map
 
 #: dp_reduce_dtype spellings -> wire dtype (None = reduce at native dtype)
 REDUCE_DTYPES = {"f32": None, "bf16": jnp.bfloat16}
+
+
+def model_axis(mesh) -> Optional[str]:
+    """The weight-sharding axis the overlap schedule composes with, or
+    ``None`` on a pure-DP mesh."""
+    if "model" in mesh.axis_names and mesh.shape["model"] > 1:
+        return "model"
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_model_leaf(x, axis: str, size: int):
+    """Model-sharded leaf (local shard) -> full tensor, inside shard_map.
+
+    Forward is a plain tiled all-gather over ``axis``.  Backward takes
+    the SLICE of the cotangent the shard owns rather than the all_gather
+    transpose (psum_scatter): the computation consuming the gathered
+    weight is replicated across ``axis`` (same data shard, same gathered
+    weights on every replica), so each replica's full-tensor cotangent
+    is already the complete gradient — a psum_scatter would sum ``size``
+    identical copies and scale the gradient by the axis size."""
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def _gml_fwd(x, axis, size):
+    return _gather_model_leaf(x, axis, size), None
+
+
+def _gml_bwd(axis, size, _res, ct):
+    shard = ct.shape[0] // size
+    idx = lax.axis_index(axis)
+    return (lax.dynamic_slice_in_dim(ct, idx * shard, shard, axis=0),)
+
+
+_gather_model_leaf.defvjp(_gml_fwd, _gml_bwd)
 
 
 class OverlapPlan:
@@ -195,6 +242,24 @@ def _run(trainer, params, data, label_vec, epoch, rng, eval_ids, mask,
     stages, body_end = plan.stages, plan.body_end
     zero = trainer.dp_zero_grads if scatter_ok else \
         jax.tree.map(lambda _: False, trainer.dp_zero_grads)
+    # model-axis composition: model-sharded leaves enter as shards
+    # (their param PartitionSpec), get all-gathered at their segment's
+    # forward entry, and their gradients leave as shards again
+    maxis = model_axis(mesh)
+    msize = mesh.shape["model"] if maxis else 1
+    msharded = trainer.dp_model_sharded
+    assert maxis is None or not with_acc, (
+        "dp_overlap: the deferred local-accumulator path is pure-DP "
+        "(the trainer gates dp_reduce_at=apply off on model meshes)")
+
+    def _gather_split(sp: Dict[str, Any]) -> Dict[str, Any]:
+        """Split params dict -> same dict with model-sharded leaves
+        gathered to full tensors (no-op on pure-DP meshes)."""
+        if maxis is None:
+            return sp
+        return {k: jax.tree.map(
+            lambda x, m: _gather_model_leaf(x, maxis, msize) if m else x,
+            grp, msharded[k]) for k, grp in sp.items()}
 
     def spmd(params, data, label_vec, epoch, rng, *rest):
         rest = list(rest)
@@ -213,16 +278,20 @@ def _run(trainer, params, data, label_vec, epoch, rng, eval_ids, mask,
         stage_fns = pipeline_net.make_stage_fns(
             net, stages, body_end, train=True, epoch=epoch,
             loss_scale=trainer.loss_scale, rng=rng_l, mesh=None)
-        # ---- forward: one vjp per bucket segment, residuals per stage
+        # ---- forward: one vjp per bucket segment, residuals per stage.
+        # Model-sharded leaves all-gather INSIDE each segment's vjp-traced
+        # forward (at that segment's entry — the async_updater walk in
+        # reverse), so backward hands their cotangents back as shards
         val = ((x,), jnp.float32(0.0), extra)
         vjps = []
         for s, fn in enumerate(stage_fns):
             val, vjp_fn = jax.vjp(
-                lambda sp, v, fn=fn: fn(sp, v, 0),
+                lambda sp, v, fn=fn: fn(_gather_split(sp), v, 0),
                 _split(params, plan.stage_keys[s]), val)
             vjps.append(vjp_fn)
 
         def tail_fn(tp, v):
+            tp = _gather_split(tp)
             acts, aux, ex = v
             nodes = dict(zip(plan.frontier, acts))
             fl, mk = ex["fields"], ex["mask"]
@@ -291,13 +360,25 @@ def _run(trainer, params, data, label_vec, epoch, rng, eval_ids, mask,
             grads = jax.tree.map(lambda x: x[None], grads)
         return loss, outs_eval, grads
 
+    def leaf_spec(z, s):
+        """Gradient out-spec for one leaf: model-sharded leaves keep
+        their param spec (the backward returns the shard), ZeRO leaves
+        data-scatter, everything else replicates."""
+        if maxis is not None and len(s.spec) and s.spec[0] == maxis:
+            return s.spec
+        return P("data") if (scatter_ok and z) else P()
+
     if reduce:
         grad_specs = {k: jax.tree.map(
-            lambda z: P("data") if (scatter_ok and z) else P(), zero[k])
+            leaf_spec, zero[k], trainer.param_shardings[k])
             for k in params}
     else:
         grad_specs = jax.tree.map(lambda _: P("data"), params)
-    in_specs = [P(), P("data"), P("data"), P(), P()]
+    param_specs = {k: jax.tree.map(lambda s: s.spec,
+                                   trainer.param_shardings[k],
+                                   is_leaf=lambda s: hasattr(s, "spec"))
+                   for k in params}
+    in_specs = [param_specs, P("data"), P("data"), P(), P()]
     args = [params, data, label_vec, epoch, rng]
     if with_acc:
         in_specs.append(P("data"))

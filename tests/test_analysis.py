@@ -198,6 +198,82 @@ def test_rule_dp_reduce_at_apply_needs_accumulation():
     assert not by_key(quiet, "dp_reduce_at")
 
 
+def test_mesh_unknown_axis_errors_with_suggestion():
+    """mesh axis names are validated at parse (MeshSpec.parse): a typo'd
+    axis is a value error with a did-you-mean suggestion."""
+    findings = conflint.lint_pairs(
+        parse_config_string("mesh = data:2,modle:2\n"))
+    ms = errors(by_key(findings, "mesh"))
+    assert ms and any("model" in f.message for f in ms)
+
+
+def test_rule_mesh_axis_product_vs_device_count():
+    findings = conflint.lint_pairs(
+        parse_config_string("mesh = data:2,model:2\ndev = cpu:0-2\n"))
+    assert any("needs 4 device" in f.message
+               for f in errors(by_key(findings, "mesh")))
+    quiet = conflint.lint_pairs(
+        parse_config_string("mesh = data:2,model:2\ndev = cpu:0-3\n"
+                            "fullc_gather = 1\n"))
+    assert not errors(by_key(quiet, "mesh"))
+    # dev without explicit ids (dev = tpu): count unknowable, no finding
+    quiet2 = conflint.lint_pairs(
+        parse_config_string("mesh = data:2,model:2\ndev = tpu\n"
+                            "fullc_gather = 1\n"))
+    assert not errors(by_key(quiet2, "mesh"))
+
+
+def test_rule_mesh_batch_divisibility():
+    findings = conflint.lint_pairs(
+        parse_config_string("mesh = data:4\nbatch_size = 10\n"))
+    assert any("not divisible by the data axis" in f.message
+               for f in errors(by_key(findings, "mesh")))
+    quiet = conflint.lint_pairs(
+        parse_config_string("mesh = data:4\nbatch_size = 16\n"))
+    assert not errors(by_key(quiet, "mesh"))
+
+
+def test_rule_mesh_dead_model_axis_info():
+    findings = conflint.lint_pairs(
+        parse_config_string("mesh = data:2,model:2\n"))
+    assert any("shards nothing" in f.message
+               for f in by_key(findings, "mesh"))
+    quiet = conflint.lint_pairs(
+        parse_config_string("mesh = data:2,model:2\nfullc_gather = 1\n"))
+    assert not any("shards nothing" in f.message
+                   for f in by_key(quiet, "mesh"))
+
+
+def test_rule_dp_overlap_mesh_combos():
+    """The dp_overlap x mesh interaction surfaces at check time instead
+    of the trainer's trace-time warn-once fallback: seq/expert/pipe
+    axes warn (fallback), a 1-wide data axis warns, a model axis with
+    deferred reduction gets the step-semantics info, and the supported
+    data x model combination stays quiet."""
+    f1 = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,seq:2\n"))
+    assert any("fall back" in f.message
+               for f in by_key(f1, "dp_overlap"))
+    f2 = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = model:4\nfullc_gather = 1\n"))
+    assert any("no data axis" in f.message
+               for f in by_key(f2, "dp_overlap"))
+    f3 = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,model:2\nfullc_gather = 1\n"
+        "update_period = 2\ndp_reduce_at = apply\n"))
+    assert any("every micro-step" in f.message
+               for f in by_key(f3, "dp_reduce_at"))
+    f4 = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,model:2\n"
+        "netconfig=start\nlayer[+1] = moe\n  num_expert = 4\n"
+        "  nhidden = 8\nnetconfig=end\ninput_shape = 1,1,8\n"))
+    assert any("hosts the experts" in f.message
+               for f in by_key(f4, "dp_overlap"))
+    quiet = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,model:2\nfullc_gather = 1\n"))
+    assert not by_key(quiet, "dp_overlap")
+
+
 def test_rule_monitor_nan_without_monitor():
     findings = conflint.lint_pairs(
         parse_config_string("monitor_nan = fatal\n"))

@@ -46,6 +46,66 @@ def test_bench_baseline_json_shape():
     assert payload["vs_baseline"] == round(1234.56 / 1000.0, 3)
 
 
+def test_bench_mesh_scaling_mode():
+    """--mesh-scaling payload on the CPU mesh: named-mesh points with
+    per-chip throughput, efficiency vs the first mesh, and the per-axis
+    comm-share fields (zero-valued but PRESENT on CPU traces)."""
+    import bench
+    payload = bench.bench_mesh_scaling(
+        ["dev=cpu", "tiny=1", "meshes=data:1;data:2,model:2",
+         "models=alexnet"])
+    assert payload["metric"] == "mesh_scaling_examples_per_sec_per_chip"
+    assert payload["value"] > 0
+    assert payload["meshes"] == ["data:1", "data:2,model:2"]
+    assert payload["efficiency_baseline_mesh"] == "data:1"
+    assert "comm_share_per_axis" in payload
+    pts = payload["models"]["alexnet"]["points"]
+    assert [p["mesh"] for p in pts] == ["data:1", "data:2,model:2"]
+    assert pts[1]["devices"] == 4
+    for row in pts:
+        for tag in ("overlap_on", "overlap_off"):
+            p = row[tag]
+            assert p["examples_per_sec_per_chip"] > 0
+            assert p["scaling_efficiency"] > 0
+            assert 0.0 <= p["comm_share"] <= 1.0
+            assert isinstance(p["comm_share_per_axis"], dict)
+    assert pts[0]["overlap_on"]["scaling_efficiency"] == 1.0
+    # engine options restored (process-global hygiene)
+    from cxxnet_tpu.engine import opts
+    assert opts.dp_overlap == "0"
+
+
+def test_bench_opt_ab_mode():
+    """--opt-ab payload on CPU (tiny): one entry per arm with step_ms
+    and the arm's engine options, plus base-relative speedups; engine
+    options restored afterwards."""
+    import bench
+    payload = bench.bench_opt_ab(
+        ["dev=cpu", "tiny=1", "arms=base,ln_x"])
+    assert payload["metric"] == "opt_ab_step_ms"
+    assert payload["value"] > 0
+    assert set(payload["arms"]) == {"base", "ln_x"}
+    for arm, entry in payload["arms"].items():
+        assert entry["step_ms"] > 0
+        assert entry["opts"] == dict(bench.OPT_AB_ARMS[arm])
+    assert payload["speedup_ln_x"] > 0
+    from cxxnet_tpu.engine import opts
+    assert opts.fused_update == "0" and opts.pallas_ln == "1"
+
+
+def test_comm_axis_shares_mapping():
+    """Per-axis attribution table: data reductions vs model gathers."""
+    import bench
+    rep = {"device_sec": 2.0,
+           "comm_by_kind": {"all-reduce": 200.0, "reduce-scatter": 100.0,
+                            "all-gather": 400.0}}
+    shares = bench._comm_axis_shares(rep)
+    assert shares == {"data": 0.15, "model": 0.2}
+    assert bench._comm_axis_shares(
+        {"device_sec": 0.0, "comm_by_kind": {"all-reduce": 1.0}}) \
+        == {"data": 0.0}
+
+
 def test_bench_dp_scaling_mode():
     """--dp-scaling payload on the CPU mesh: per-device-count per-chip
     throughput, scaling efficiency vs the 1-device point, and

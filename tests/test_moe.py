@@ -178,3 +178,51 @@ silent = 1
     any_leaf = next(iter(m_state.values()))
     assert any_leaf.sharding.spec == P("expert", None, None)
     assert t.params[moe_key]["gate"].sharding.spec == P()
+
+
+def test_moe_model_axis_hosts_experts():
+    """On a mesh with no dedicated expert axis (mesh = data:2,model:2 —
+    the first-class 2-D config) the MODEL axis hosts the experts: the
+    per-expert weights shard over it at rest and the dispatch/combine
+    constraints rewrite their canonical "expert" spelling to it
+    (moe._expert_axis).  Training stays finite and replica-consistent."""
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    CONF = """
+netconfig=start
+layer[0->1] = embedding
+  vocab_size = 32
+  nhidden = 16
+layer[1->2] = moe
+  num_expert = 4
+  nhidden = 32
+layer[2->3] = seq_fullc
+  nhidden = 32
+layer[3->3] = softmax_seq
+netconfig=end
+label_vec[0,8) = label
+input_shape = 1,1,8
+batch_size = 8
+dev = cpu:0-3
+mesh = data:2,model:2
+eta = 0.05
+updater = adam
+metric = error
+silent = 1
+"""
+    t = NetTrainer()
+    for k, v in parse_config_string(CONF):
+        t.set_param(k, v)
+    t.init_model()
+    from jax.sharding import PartitionSpec as P
+    (moe_key,) = [k for k in t.params if "moe" in k]
+    assert t.params[moe_key]["wmat"].sharding.spec == P("model", None, None)
+    assert t.params[moe_key]["gate"].sharding.spec == P()
+    rnd = np.random.RandomState(0)
+    toks = rnd.randint(0, 32, (8, 8)).astype(np.float32)
+    for _ in range(2):
+        t.update(DataBatch(data=toks.reshape(8, 1, 1, 8), label=toks,
+                           index=np.arange(8, dtype=np.uint32)))
+    assert np.isfinite(float(np.asarray(t._last_loss)))
+    assert t.check_weight_consistency() == 0.0

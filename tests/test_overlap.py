@@ -91,7 +91,7 @@ def _batches(n, batch=16, shape=(3, 16, 16), classes=4, tail_padd=0):
 
 def _train(net, overlap, extra=(), *, bucket_mb="0.001",
            reduce_at="apply", reduce_dtype="f32", n_steps=4,
-           shape=(3, 16, 16), tail_padd=0):
+           shape=(3, 16, 16), tail_padd=0, mesh="data:4"):
     """One fresh trainer, n_steps updates; returns (losses, params,
     opt_state, trainer).  Engine options are process-global and read at
     trace time, so each run sets them BEFORE its first update and the
@@ -100,7 +100,7 @@ def _train(net, overlap, extra=(), *, bucket_mb="0.001",
     engine.opts.set("dp_bucket_mb", bucket_mb)
     engine.opts.set("dp_reduce_at", reduce_at)
     engine.opts.set("dp_reduce_dtype", reduce_dtype)
-    t = _make_trainer(net, 16, "cpu:0-3", extra=[("mesh", "data:4")]
+    t = _make_trainer(net, 16, "cpu:0-3", extra=[("mesh", mesh)]
                       + list(extra))
     t.start_round(1)
     losses = []
@@ -337,6 +337,177 @@ dp_bucket_mb = 0.0001
         losses[ov] = [r["loss"] for r in recs if r["kind"] == "step"]
         engine.opts.set("dp_overlap", "0")
     assert losses["0"] and losses["0"] == losses["1"]
+
+
+# ------------------------------------------------- 2-D (data x model) mesh
+
+# conv wmat (256, 3, 5, 5) = 19.2k leaves: 4-D (never model-sharded),
+# crosses the ZeRO size floor -> reduce-scatter over data; the fullc
+# wmats are 2-D with even leading dims -> model-sharded under
+# fullc_gather (all-gathered at their segment's forward entry)
+MESH_NET = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 5
+  stride = 2
+  nchannel = 256
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:fc1
+  nhidden = 32
+layer[4->5] = relu
+layer[5->6] = fullc:fc2
+  nhidden = 4
+layer[6->6] = softmax
+netconfig=end
+input_shape = 3,16,16
+metric = error
+eta = 0.1
+momentum = 0.9
+silent = 1
+"""
+
+MESH = "data:2,model:2"
+
+
+@pytest.mark.parametrize("tag,extra,kw", [
+    ("plain", (("fullc_gather", "1"),), {}),
+    ("tail_mask", (("fullc_gather", "1"),), {"tail_padd": 5}),
+    ("zero", (("fullc_gather", "1"), ("shard_opt_state", "1")), {}),
+    # update_period at dp_reduce_at=step: per-micro-step reductions in
+    # the implicit path's order -> bitwise on the 2-D mesh too
+    ("update_period", (("fullc_gather", "1"), ("update_period", "2")),
+     {"reduce_at": "step"}),
+])
+def test_mesh_overlap_bitwise_parity(tag, extra, kw):
+    """The overlapped step on a data:2,model:2 mesh with MODEL-SHARDED
+    weights (fullc wmats P("model", None), gathered at segment entry,
+    gradients psum'd over data at their bucket's grad-ready point) is
+    trajectory-BITWISE-identical to the implicit step with replicated
+    weights at f32: per-device compute is identical (the gathered shards
+    reconstruct the full weight bit-for-bit; compute replicates across
+    model) and the data-axis psum groups are the same 2-member sets."""
+    on = _train(MESH_NET, True, extra, mesh=MESH, **kw)
+    t = on[3]
+    assert any(jax.tree.leaves(t.dp_model_sharded)), \
+        "test net must model-shard at least one leaf"
+    assert t._dp_overlap_active(), "must run the overlapped step, not " \
+        "the fallback"
+    # the implicit anchor: same mesh, same net, weights replicated
+    # (fullc_gather off) — the model axis then carries redundant compute,
+    # exactly what the overlap path's gathered forward computes
+    off = _train(MESH_NET, False,
+                 tuple(kv for kv in extra if kv[0] != "fullc_gather"),
+                 mesh=MESH, **kw)
+    assert on[0] == off[0], f"{tag}: per-step losses must be bitwise equal"
+    _assert_trees_equal(off[1], on[1], f"{tag}: params diverged")
+    _assert_trees_equal(off[2], on[2], f"{tag}: optimizer state diverged")
+
+
+def test_mesh_overlap_tracks_gspmd_sharded_implicit():
+    """Against the implicit step with the SAME model-sharded
+    NamedShardings (GSPMD places the tensor-parallel collectives and may
+    reassociate contractions), the overlapped trajectory agrees to FP
+    tolerance — the sharded implicit path is a different but equivalent
+    schedule, not the bitwise anchor."""
+    on = _train(MESH_NET, True, (("fullc_gather", "1"),), mesh=MESH)
+    off = _train(MESH_NET, False, (("fullc_gather", "1"),), mesh=MESH)
+    np.testing.assert_allclose(on[0], off[0], rtol=1e-6)
+    for x, y in zip(jax.tree.leaves(on[1]), jax.tree.leaves(off[1])):
+        np.testing.assert_allclose(x, y, rtol=1e-4, atol=1e-6)
+
+
+def test_mesh_overlap_hlo_composes_collectives():
+    """The lowered 2-D-mesh overlapped step carries the bucketed
+    DATA-axis all-reduces (>= one per bucket) COMPOSED with the
+    model-axis weight all-gathers, plus the ZeRO reduce-scatter — the
+    acceptance shape for the mesh generalization."""
+    on = _train(MESH_NET, True,
+                (("fullc_gather", "1"), ("shard_opt_state", "1")),
+                n_steps=1, mesh=MESH)
+    t = on[3]
+    n_buckets = len(t._dp_overlap_plan().stages)
+    assert n_buckets >= 2
+    n_gather_leaves = sum(jax.tree.leaves(t.dp_model_sharded))
+    assert n_gather_leaves >= 2
+    assert any(jax.tree.leaves(t.dp_zero_grads))
+    data = jnp.zeros((16, 3, 16, 16), jnp.float32)
+    label = jnp.zeros((16, 1), jnp.float32)
+    engine.opts.set("dp_overlap", "1")
+    txt = t._train_step.lower(
+        t.params, t.opt_state, t.buffers, data, label, (),
+        jnp.int32(0), jax.random.PRNGKey(0)).as_text()
+    assert len(re.findall(r"all_reduce", txt)) >= n_buckets
+    assert len(re.findall(r"all_gather", txt)) >= n_gather_leaves
+    assert "reduce_scatter" in txt
+
+
+def test_mesh_overlap_apply_defer_falls_back_to_step(capsys):
+    """dp_reduce_at = apply is pure-DP: on a model mesh the trainer
+    warns once and reduces every micro-step (step semantics) — which is
+    also the bitwise mode, asserted against the replicated implicit
+    run."""
+    on = _train(MESH_NET, True,
+                (("fullc_gather", "1"), ("update_period", "2")),
+                mesh=MESH, reduce_at="apply")
+    assert not on[3]._overlap_defer
+    assert "pure-DP" in capsys.readouterr().err
+    off = _train(MESH_NET, False, (("update_period", "2"),), mesh=MESH,
+                 reduce_at="apply")
+    assert on[0] == off[0]
+    _assert_trees_equal(off[1], on[1], "apply-defer fallback diverged")
+
+
+def test_mesh_overlap_moe_model_axis_falls_back(capsys):
+    """MoE on a model mesh axis: the model axis HOSTS the experts
+    (moe.expert_host_axis) and their dispatch/combine all-to-alls are
+    GSPMD-placed — dp_overlap warns once and keeps the implicit step
+    (the explicit step's mesh-less forward would silently resolve
+    moe_dispatch=auto to the differently-associated sorted path)."""
+    net = """
+netconfig=start
+layer[0->1] = embedding
+  vocab_size = 32
+  nhidden = 16
+layer[1->2] = moe
+  num_expert = 4
+  nhidden = 32
+layer[2->3] = seq_fullc
+  nhidden = 32
+layer[3->3] = softmax_seq
+netconfig=end
+label_vec[0,8) = label
+input_shape = 1,1,8
+metric = error
+eta = 0.05
+updater = adam
+silent = 1
+"""
+    engine.opts.set("dp_overlap", "1")
+    t = _make_trainer(net, 8, "cpu:0-3", extra=[("mesh", MESH)])
+    t.start_round(1)
+    rnd = np.random.RandomState(0)
+    toks = rnd.randint(0, 32, (8, 8)).astype(np.float32)
+    from cxxnet_tpu.io.data import DataBatch
+    t.update(DataBatch(data=toks.reshape(8, 1, 1, 8), label=toks,
+                       index=np.arange(8, dtype=np.uint32)))
+    assert np.isfinite(float(np.asarray(t._last_loss)))
+    err = capsys.readouterr().err
+    assert "dp_overlap = 1 ignored" in err and "MoE experts" in err
+
+
+def test_mesh_overlap_seq_axis_still_falls_back(capsys):
+    """Axes the segment walk can't host (seq/expert/pipe) keep the
+    warn-once implicit fallback."""
+    engine.opts.set("dp_overlap", "1")
+    t = _make_trainer(CONV_NET, 16, "cpu:0-3",
+                      extra=[("mesh", "data:2,seq:2")])
+    t.start_round(1)
+    (b,) = _batches(1)
+    t.update(b)
+    assert np.isfinite(float(np.asarray(t._last_loss)))
+    err = capsys.readouterr().err
+    assert "dp_overlap = 1 ignored" in err and "seq" in err
 
 
 def test_plan_buckets_reverse_order_sizing():

@@ -312,6 +312,72 @@ def test_max_pool_hwcn_matches_eq(shape, k, s):
     np.testing.assert_allclose(np.asarray(da), np.asarray(db), atol=1e-4)
 
 
+@pytest.mark.parametrize("shape,k,s", [
+    ((4, 16, 27, 27), 3, 2),   # AlexNet pool2 family (overlapping)
+    ((2, 8, 13, 13), 3, 2),    # clipped tail
+    ((2, 8, 12, 12), 2, 2),    # VGG/LeNet family
+    ((2, 8, 9, 9), 3, 1),      # inception same-size branch (no pad)
+    ((2, 8, 56, 56), 3, 2),    # GoogLeNet stage pool family
+])
+def test_max_pool_relu_fused_matches_unfused(shape, k, s):
+    """relu-fused multi-row pool backward (pool_relu_fuse;
+    pallas_kernels.max_pool_relu_hwcn): forward AND gradient are
+    bitwise ALL-TIES-identical to the unfused pair relu∘max_pool_hwcn
+    in interpret mode — the in-kernel ``pv > 0`` mask epilogue is
+    exactly relu's where(out > 0, dy, 0) because pv is the pre-relu
+    pool output."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.pallas_kernels import (max_pool_hwcn,
+                                               max_pool_relu_hwcn)
+    # shifted below zero so a real fraction of WINDOW MAXIMA are negative
+    # (a max of k*k unit Gaussians is almost never negative unshifted —
+    # the relu mask would be vacuously all-ones)
+    x = jnp.asarray(np.random.RandomState(1).randn(*shape) - 1.5,
+                    jnp.float32)
+    fused = max_pool_relu_hwcn(x, k, s)
+    unfused = jnp.maximum(max_pool_hwcn(x, k, s), 0)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+    assert (np.asarray(fused) == 0).mean() > 0.2
+    g = jnp.asarray(np.random.RandomState(2).randn(*fused.shape),
+                    jnp.float32)
+    da = jax.vjp(lambda v: max_pool_relu_hwcn(v, k, s), x)[1](g)[0]
+    db = jax.vjp(lambda v: jnp.maximum(max_pool_hwcn(v, k, s), 0),
+                 x)[1](g)[0]
+    np.testing.assert_array_equal(np.asarray(da), np.asarray(db))
+
+
+def test_max_pool2d_relu_dispatcher_unfused_identity():
+    """ops.nn.max_pool2d_relu with pool_relu_fuse=0 (default) is exactly
+    apply_relu(max_pool2d(.)) — the pre-fusion execution form — for both
+    values and gradients; pool_relu_fuse=1 on CPU keeps the same path
+    (the fused kernel is gated to shapes the TPU hwcn kernel takes)."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu import engine
+    from cxxnet_tpu.layers.activation import apply_relu
+    from cxxnet_tpu.ops import nn as N
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 4, 10, 10),
+                    jnp.float32)
+    ref_fn = lambda v: apply_relu(N.max_pool2d(v, 3, 3, 2))  # noqa: E731
+    ref = ref_fn(x)
+    g = jnp.asarray(np.random.RandomState(4).randn(*ref.shape),
+                    jnp.float32)
+    dref = jax.vjp(ref_fn, x)[1](g)[0]
+    saved = engine.opts.pool_relu_fuse
+    try:
+        for fuse in ("0", "1"):
+            engine.opts.set("pool_relu_fuse", fuse)
+            got = N.max_pool2d_relu(x, 3, 3, 2)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+            dgot = jax.vjp(lambda v: N.max_pool2d_relu(v, 3, 3, 2),
+                           x)[1](g)[0]
+            np.testing.assert_array_equal(np.asarray(dgot),
+                                          np.asarray(dref))
+    finally:
+        engine.opts.set("pool_relu_fuse", saved)
+
+
 @pytest.mark.parametrize("geom", [
     (8, 3, 23, 23, 16, 11, 4),   # AlexNet conv1 class (kb=3)
     (4, 3, 18, 18, 8, 5, 2),     # 5x5/s2 class (kb=3)

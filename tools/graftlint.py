@@ -23,6 +23,13 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# mesh configs trace on an N-device host-platform mesh; the flag must be
+# set before the first backend initialization, i.e. here at process start
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
 
 
 def main() -> int:
