@@ -19,15 +19,19 @@ import time
 import numpy as np
 
 BASELINE_IMGS_PER_SEC = 1000.0
-PEAK_FLOPS = {  # bf16 peak per chip
-    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
-    "TPU v5p": 459e12, "TPU v6e": 918e12,
-}
-
 
 def peak_flops(device_kind: str) -> float:
-    return next((v for k, v in PEAK_FLOPS.items() if k in device_kind),
-                197e12)
+    # chip peaks live with the analytic cost model (one table for bench
+    # MFU, layer attribution, and roofline distance — doc/monitor.md)
+    from cxxnet_tpu.analysis.costmodel import peak_flops as _pf
+    return _pf(device_kind) or 197e12
+
+
+def __getattr__(name):  # PEP 562: keep `from bench import PEAK_FLOPS`
+    if name == "PEAK_FLOPS":  # (experiments/) without an eager package
+        from cxxnet_tpu.analysis.costmodel import PEAK_FLOPS  # import
+        return PEAK_FLOPS
+    raise AttributeError(name)
 
 
 def baseline_json(imgs_per_sec: float, extra: dict = None) -> dict:

@@ -288,6 +288,37 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
         add(Finding("warn", "monitor_nan",
                     "the NaN/inf loss guard is only checked when "
                     "monitor = 1; monitor_nan has no effect here"))
+    # --- observatory knobs (doc/monitor.md: prof_every / sentinel) ---
+    prof_every = _as_int(last, "prof_every", 0)
+    if prof_every > 0:
+        if _as_int(last, "prof_start_step", -1) >= 0:
+            add(Finding("warn", "prof_every",
+                        "prof_every opens recurring round windows but "
+                        "prof_start_step pins a one-shot step-addressed "
+                        "window; prof_every will be ignored"))
+        if not last.get("prof", ""):
+            add(Finding("warn", "prof_every",
+                        "prof_every has no effect without prof = <dir> "
+                        "(no trace directory, no profiling windows)"))
+        if monitor and multi_step > 1:
+            add(Finding("warn", "prof_every",
+                        "monitor = 1 disables multi_step grouped "
+                        "dispatch, so every prof_every window will "
+                        "profile per-batch dispatch — not the grouped "
+                        "steady state the run would otherwise have"))
+    sink_on = last.get("metrics_sink", "") not in ("", "none", "0")
+    if _as_int(last, "sentinel", 0):
+        if not sink_on:
+            add(Finding("warn", "sentinel",
+                        "sentinel = 1 without metrics_sink: anomaly and "
+                        "flight-recorder records have nowhere to land; "
+                        "set metrics_sink = jsonl:<path>"))
+    else:
+        for k in ("sentinel_rel", "sentinel_warmup", "sentinel_ring"):
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect without sentinel = 1"))
+                break
     if batch_split > 1 and batch_size and batch_size % batch_split:
         add(Finding("error", "batch_split",
                     f"batch_size = {batch_size} is not divisible by "

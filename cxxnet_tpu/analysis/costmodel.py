@@ -1,0 +1,101 @@
+"""Analytic per-layer cost model: flops / bytes / chip peaks.
+
+One place for the numbers the perf tooling keeps re-deriving: bench.py's
+MFU denominator (it imports :func:`peak_flops` from here), the
+layer-attribution roofline columns (monitor/attribution.py), and the
+GoogLeNet-style "measured vs modeled" distance ROADMAP item 4 is chased
+with.  The model is deliberately COARSE — the same 2*MACs convention
+BASELINE.md's lowering campaigns use:
+
+* conv / fullc: ``2 * MACs`` forward; everything else is counted as one
+  flop per input+output element (elementwise/reduction layers are
+  bandwidth-, not compute-bound, so their flops only matter for the
+  bytes-side roofline anyway);
+* bytes: activations in + out + parameters, 4 bytes each (f32; bf16
+  runs are ~2x better than this floor — the model is a per-layer
+  RANKING aid, not a calibrated simulator);
+* training multiplier 3x (fwd + input-grad + weight-grad), the
+  convention bench.py reports MFU with.
+
+Shapes come from the built :class:`~cxxnet_tpu.nnet.net.Network` (batch
+included), keyed by the SAME scope strings the net builder stamps
+(layers/base.conn_scope_name), so attribution joins by dict lookup.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: advertised bf16 peak per chip (matmul flops/sec)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+    "TPU v5p": 459e12, "TPU v6e": 918e12,
+}
+
+#: advertised HBM bandwidth per chip (bytes/sec)
+PEAK_BW = {
+    "TPU v5 lite": 819e9, "TPU v5e": 819e9, "TPU v4": 1228e9,
+    "TPU v5p": 2765e9, "TPU v6e": 1640e9,
+}
+
+TRAIN_FLOP_MULT = 3.0  # fwd + dgrad + wgrad, the bench.py convention
+
+
+def peak_flops(device_kind: str) -> Optional[float]:
+    """Chip bf16 peak, or None for unknown kinds (CPU hosts) — callers
+    omit MFU columns rather than report against a made-up peak."""
+    return next((v for k, v in PEAK_FLOPS.items() if k in device_kind),
+                None)
+
+
+def peak_bw(device_kind: str) -> Optional[float]:
+    return next((v for k, v in PEAK_BW.items() if k in device_kind),
+                None)
+
+
+def _elems(shape) -> float:
+    n = 1.0
+    for d in shape:
+        n *= d
+    return n
+
+
+def layer_costs(net, train: bool = True) -> Dict[str, Dict[str, float]]:
+    """Per-connection analytic cost: scope -> {flops, bytes} per STEP
+    (the global batch is in the node shapes).  Shared connections get
+    their own entry (they execute separately even though parameters
+    alias)."""
+    from ..layers.base import conn_scope_name
+    from ..layers.conv import ConvolutionLayer
+    from ..layers.fullc import FullConnectLayer
+    mult = TRAIN_FLOP_MULT if train else 1.0
+    out: Dict[str, Dict[str, float]] = {}
+    for i, conn in enumerate(net.connections):
+        l = conn.layer
+        in_elems = sum(_elems(net.node_shapes[n]) for n in conn.nindex_in)
+        out_elems = sum(_elems(net.node_shapes[n])
+                        for n in conn.nindex_out)
+        param_elems = 0.0
+        if isinstance(l, ConvolutionLayer):
+            n, co, oh, ow = net.node_shapes[conn.nindex_out[0]]
+            ci = net.node_shapes[conn.nindex_in[0]][1]
+            p = l.param
+            macs = (n * co * oh * ow * (ci // p.num_group)
+                    * p.kernel_height * p.kernel_width)
+            flops = 2.0 * macs
+            param_elems = (co * (ci // p.num_group)
+                           * p.kernel_height * p.kernel_width)
+        elif isinstance(l, FullConnectLayer):
+            shp_in = net.node_shapes[conn.nindex_in[0]]
+            nin = shp_in[1] * shp_in[2] * shp_in[3]
+            nout = l.param.num_hidden
+            flops = 2.0 * shp_in[0] * nin * nout
+            param_elems = float(nin) * nout
+        else:
+            flops = in_elems + out_elems
+        out[conn_scope_name(i, conn)] = {
+            "flops": mult * flops,
+            "bytes": (mult / 2.0) * 4.0 * (in_elems + out_elems
+                                           + param_elems),
+        }
+    return out

@@ -51,6 +51,18 @@ TASK_KEYS = (
     K("prof", "path"),
     K("prof_start_step", "int", lo=-1),
     K("prof_num_steps", "int", lo=0),
+    K("prof_every", "int", lo=0,
+      help="recurring profiling windows: trace every Nth round"),
+    K("sentinel", "int", lo=0, hi=1,
+      help="EWMA regression sentinels over step time / comm_share / "
+           "HBM high-water (anomaly records need metrics_sink)"),
+    K("sentinel_rel", "float", lo=0.01, hi=10.0,
+      help="relative deviation vs the EWMA that fires an anomaly "
+           "(must be > 0: a zero threshold fires on every observation)"),
+    K("sentinel_warmup", "int", lo=1),
+    K("sentinel_ring", "int", lo=1,
+      help="flight-recorder depth: last K step records dumped on an "
+           "anomaly or TrainingDiverged"),
     K("test_on_server", "int", lo=0, hi=1),
     # the runtime deliberately tolerates unknown spellings (treated as
     # binary, with a warning) — soft keeps the lint at warn severity
@@ -99,6 +111,19 @@ class LearnTask:
         # window — the whole round past compilation
         self.prof_start_step = -1
         self.prof_num_steps = 0
+        # prof_every = N: recurring low-overhead profiling windows — a
+        # fresh trace (and its trace/layer_profile records) every Nth
+        # round instead of the single one-shot window (doc/monitor.md)
+        self.prof_every = 0
+        # regression sentinels + flight recorder (monitor/sentinel.py)
+        self.sentinel = 0
+        self.sentinel_rel = 0.2
+        self.sentinel_warmup = 3
+        self.sentinel_ring = 64
+        self._sentinel_bank = None
+        # instruction->scope join, cached like trainer._step_hlo_cache:
+        # recurring prof_every windows must not re-scan the HLO text
+        self._op_scopes_cache = None
         # wall seconds of the first train dispatch (jit trace + compile
         # happen synchronously inside it); None until it ran
         self.compile_sec: Optional[float] = None
@@ -160,6 +185,16 @@ class LearnTask:
             self.prof_start_step = int(val)
         elif name == "prof_num_steps":
             self.prof_num_steps = int(val)
+        elif name == "prof_every":
+            self.prof_every = int(val)
+        elif name == "sentinel":
+            self.sentinel = int(val)
+        elif name == "sentinel_rel":
+            self.sentinel_rel = float(val)
+        elif name == "sentinel_warmup":
+            self.sentinel_warmup = int(val)
+        elif name == "sentinel_ring":
+            self.sentinel_ring = int(val)
         elif name == "test_on_server":
             self.test_on_server = int(val)
         elif name == "output_format":
@@ -332,24 +367,72 @@ class LearnTask:
         self._pred_prefetcher = None
 
     def _emit_trace_report(self, prof: ProfileWindow) -> None:
-        """Comm/compute attribution of a closed profile window: per-step
-        ``comm_sec`` / ``overlap_frac`` gauges plus a ``trace`` record
-        (doc/monitor.md) — the measured collective time the dp_overlap
-        schedule is judged on.  Parse failures must never kill training."""
+        """Reports from one closed profile window: per-step ``comm_sec``
+        / ``overlap_frac`` gauges plus a ``trace`` record (the measured
+        collective time the dp_overlap schedule is judged on) and a
+        ``layer_profile`` record (per-layer device-time attribution with
+        roofline distance, doc/monitor.md).  The window's xplane is
+        parsed ONCE and feeds both.  Parse failures must never kill
+        training."""
         metrics = self.net.metrics if self.net else None
         if metrics is None:
             return
+        tdir = prof.last_window_dir or self.prof_dir
+        steps = max(prof.last_window_steps, 1)
         try:
-            from .monitor.trace import comm_report
-            rep = comm_report(self.prof_dir,
-                              steps=max(prof.steps_traced, 1))
+            from .monitor.trace import (comm_report_in, find_xplane,
+                                        parse_xspace)
+            planes = parse_xspace(find_xplane(tdir))
+            rep = comm_report_in(planes, steps=steps)
         except Exception as e:  # noqa: BLE001 — telemetry only
-            mlog.warn(f"trace summary of {self.prof_dir} failed: {e}")
+            mlog.warn(f"trace summary of {tdir} failed: {e}")
             return
         metrics.set_gauge("comm_sec", rep["comm_sec"])
         metrics.set_gauge("overlap_frac", rep["overlap_frac"])
         if metrics.active:
             metrics.emit("trace", round=self.start_counter - 1, **rep)
+            if self._sentinel_bank is not None:
+                self._sentinel_bank.observe_trace(
+                    dict(rep, round=self.start_counter - 1))
+            self._emit_layer_profile(planes, steps)
+
+    def _emit_layer_profile(self, planes, steps: int) -> None:
+        """Join the window's per-op device times against the stamped
+        layer scopes (monitor/attribution.py) and the analytic cost
+        model (analysis/costmodel.py); emit one ``layer_profile`` record
+        carrying the whole table.  Runs only with an active sink, so
+        the one extra AOT compile ``step_hlo_text`` pays (cached per
+        trainer) is an explicit observability opt-in."""
+        net = self.net
+        metrics = net.metrics
+        try:
+            from .analysis import costmodel
+            from .monitor import attribution
+            scopes = net.layer_scopes()
+            op_scopes = self._op_scopes_cache
+            if op_scopes is None:
+                hlo = net.step_hlo_text()
+                op_scopes = attribution.hlo_op_scopes(hlo, scopes) \
+                    if hlo else {}
+                self._op_scopes_cache = op_scopes
+            kind = net.devices[0].device_kind
+            table = attribution.layer_table(
+                planes, scopes, op_scopes, steps=steps,
+                costs=costmodel.layer_costs(net.net),
+                peak_flops=costmodel.peak_flops(kind),
+                peak_bw=costmodel.peak_bw(kind))
+            metrics.emit("layer_profile", round=self.start_counter - 1,
+                         **table)
+            if not mlog.is_silent() and table["rows"]:
+                top = ", ".join(
+                    f"{r['layer']} {r['device_ms']:.3g} ms"
+                    for r in table["rows"][:3])
+                mlog.info(
+                    f"layer_profile: {table['attributed_ms']:.3g} of "
+                    f"{table['device_total_ms']:.3g} ms/step attributed "
+                    f"({table['coverage'] * 100:.0f}%); top: {top}")
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            mlog.warn(f"layer attribution failed: {e}")
 
     # ---------------------------------------------------------------- tasks
     def _save_model(self) -> None:
@@ -378,8 +461,27 @@ class LearnTask:
             mlog.notice("start I/O test")
         cc = self.max_round
         rounds_done = 0
+        if self.prof_every > 0 and self.prof_start_step >= 0:
+            # lint surfaces this at check time too (doc/check.md):
+            # a step-pinned one-shot window and a recurring round
+            # cadence can't both own the profiler
+            mlog.warn("prof_every ignored: prof_start_step pins a "
+                      "one-shot step-addressed window")
+            self.prof_every = 0
         prof = ProfileWindow(self.prof_dir, self.prof_start_step,
-                             self.prof_num_steps)
+                             self.prof_num_steps, every=self.prof_every)
+        if self.sentinel and metrics.active:
+            from .monitor.sentinel import SentinelBank
+            self._sentinel_bank = SentinelBank(
+                metrics, rel=self.sentinel_rel,
+                warmup=self.sentinel_warmup, ring=self.sentinel_ring)
+        elif self.sentinel:
+            # every sentinel output goes to the sink; armed without one
+            # it would only add a per-print-step D2H loss sync (lint
+            # surfaces this at check time too — doc/check.md)
+            mlog.warn("sentinel=1 without metrics_sink: sentinels "
+                      "disarmed")
+        bank = self._sentinel_bank
         # legacy window: profile the second round (past compilation) — or
         # the only round when just one will run; prof_start_step >= 0
         # pins the window to an exact global update step instead
@@ -488,8 +590,8 @@ class LearnTask:
                         else:
                             dispatch_mark += dt
                         if prof.after_step():
-                            mlog.info(
-                                f"profile trace written to {self.prof_dir}")
+                            mlog.info("profile trace written to "
+                                      f"{prof.last_window_dir}")
                             self._emit_trace_report(prof)
                     for b in metas:
                         sample_counter += 1
@@ -500,10 +602,15 @@ class LearnTask:
                         if sample_counter % self.print_step == 0:
                             now = time.time()
                             rate = n_mark / max(now - t_mark, 1e-9)
+                            # metrics.active alone: the bank only arms
+                            # with an active sink, and if the sink dies
+                            # mid-run (emit's OSError guard) this also
+                            # stops paying the D2H loss sync for
+                            # records nobody will see
                             if metrics.active and self.test_io == 0:
                                 loss = getattr(self.net, "_last_loss", None)
-                                metrics.emit(
-                                    "step", round=self.start_counter - 1,
+                                rec = dict(
+                                    round=self.start_counter - 1,
                                     step=sample_counter,
                                     global_step=self.net.sample_counter,
                                     elapsed_sec=round(now - start, 3),
@@ -516,6 +623,9 @@ class LearnTask:
                                     if depth_n else 0.0,
                                     loss=None if loss is None
                                     else float(np.asarray(loss)))
+                                metrics.emit("step", **rec)
+                                if bank is not None:
+                                    bank.observe_step(rec)
                             t_mark, n_mark = now, 0
                             iter_wait += iter_wait_mark
                             dispatch_sec += dispatch_mark
@@ -528,7 +638,8 @@ class LearnTask:
                                 f"sec elapsed, {rate:.1f} examples/sec")
                             self._report_diagnostics()
                 if prof.round_end():
-                    mlog.info(f"profile trace written to {self.prof_dir}")
+                    mlog.info("profile trace written to "
+                              f"{prof.last_window_dir}")
                     self._emit_trace_report(prof)
                 rounds_done += 1
                 iter_wait += iter_wait_mark
@@ -579,7 +690,16 @@ class LearnTask:
                         rec["compile_sec"] = round(self.compile_sec, 3)
                     rec.update(self.net.memory_gauges())
                     metrics.emit("round", **rec)
+                    if bank is not None:
+                        bank.observe_round(rec)
                 self._save_model()
+        except BaseException as e:
+            # flight recorder: the last K step records — the run's final
+            # approach into a TrainingDiverged or any mid-round failure —
+            # land in the sink before the raise propagates
+            if bank is not None:
+                bank.flight_dump(f"{type(e).__name__}: {e}")
+            raise
         finally:
             # producer threads must not outlive the task — a mid-round
             # raise (TrainingDiverged, iterator failure) joins the train
@@ -587,14 +707,22 @@ class LearnTask:
             if src is not None:
                 src.close()
             self._close_prefetchers()
-        if prof.active:
-            # a step-bounded window the run never filled (prof_num_steps
-            # past the last dispatch, or test_io=1): flush it rather than
-            # leave the profiler running into process exit
-            prof.stop()
-            mlog.info(f"profile trace written to {self.prof_dir} "
-                      "(window truncated at training end)")
-            self._emit_trace_report(prof)
+            if prof.active:
+                # a window the run never closed: prof_num_steps past the
+                # last dispatch, test_io=1, or a mid-round raise landing
+                # inside an open window (TrainingDiverged under
+                # prof_every) — flush it so the incident window's trace
+                # + layer_profile records survive, and the profiler
+                # never runs into process exit.  Guarded: a flush
+                # failure must not mask the in-flight exception.
+                try:
+                    prof.stop()
+                    mlog.info("profile trace written to "
+                              f"{prof.last_window_dir} "
+                              "(window truncated at training end)")
+                    self._emit_trace_report(prof)
+                except Exception as pe:
+                    mlog.warn(f"profile window flush failed: {pe}")
         mlog.info(f"\nupdating end, {int(time.time() - start)} sec in all")
 
     def _train_synth_device(self) -> None:
@@ -731,6 +859,26 @@ class LearnTask:
         reg.close()
         return code
 
+    def _observe_latency(self, op: str, sec: float) -> None:
+        """Per-batch inference latency into the registry histogram —
+        the p50/p95/p99 the serving path (ROADMAP item 1) is judged
+        on."""
+        self.net.metrics.observe(f"{op}_latency_sec", sec)
+
+    def _emit_latency_record(self, op: str) -> None:
+        """One ``latency`` record per pred/extract task: count + mean +
+        percentiles of the per-batch dispatch+D2H wall (doc/monitor.md)."""
+        metrics = self.net.metrics
+        h = metrics.histograms.get(f"{op}_latency_sec")
+        if h is None or not h.count:
+            return
+        s = h.summary()
+        metrics.emit("latency", op=op, count=int(s["count"]),
+                     **{k: round(s[k] * 1e3, 3)
+                        for k in ("mean", "min", "max",
+                                  "p50", "p95", "p99")},
+                     unit="ms")
+
     def task_predict(self) -> None:
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
@@ -743,9 +891,13 @@ class LearnTask:
                     batch = src.next()
                     if batch is None:
                         break
+                    t0 = time.perf_counter()
                     pred = self.net.predict(batch)
+                    self._observe_latency("pred",
+                                          time.perf_counter() - t0)
                     for v in pred:
                         fo.write(f"{v:g}\n")
+            self._emit_latency_record("pred")
         finally:
             self._close_prefetchers()
         mlog.notice(f"finished prediction, write into {self.name_pred}")
@@ -765,9 +917,13 @@ class LearnTask:
                     batch = src.next()
                     if batch is None:
                         break
+                    t0 = time.perf_counter()
                     out = self.net.predict_raw(batch)
+                    self._observe_latency("pred",
+                                          time.perf_counter() - t0)
                     for row in out:
                         fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+            self._emit_latency_record("pred")
         finally:
             self._close_prefetchers()
         mlog.notice(f"finished prediction, write into {self.name_pred}")
@@ -788,7 +944,10 @@ class LearnTask:
                     batch = src.next()
                     if batch is None:
                         break
+                    t0 = time.perf_counter()
                     feat = self.net.extract_feature(batch, node)
+                    self._observe_latency("extract",
+                                          time.perf_counter() - t0)
                     if not wrote_meta:
                         with open(self.name_pred + ".meta", "w") as fm:
                             fm.write(f"{feat.shape[1]}\n")
@@ -801,6 +960,7 @@ class LearnTask:
                     else:
                         for row in feat:
                             fo.write(" ".join(f"{v:g}" for v in row) + "\n")
+            self._emit_latency_record("extract")
         finally:
             self._close_prefetchers()
         mlog.notice(f"finished extraction, write into {self.name_pred}")
@@ -831,10 +991,27 @@ class LearnTask:
             else:
                 raise ValueError(f"unknown task {self.task!r}")
         finally:
-            self._close_prefetchers()  # backstop; tasks close their own
+            # each close guarded: the broken iterator that aborted the
+            # task often fails its close() too, and that must neither
+            # mask the original exception nor starve the closes after it
+            try:
+                self._close_prefetchers()  # backstop; tasks close own
+            except Exception as ce:
+                mlog.warn(f"prefetcher close failed: {ce}")
             for it in ([self.itr_train] if self.itr_train else []) + \
                     self.itr_evals + ([self.itr_pred] if self.itr_pred else []):
-                it.close()
+                try:
+                    it.close()
+                except Exception as ce:
+                    mlog.warn(f"iterator close failed: {ce}")
+            # task-level sink teardown: flush+close HERE, after the
+            # task's own emits (flight dumps, trace reports, latency
+            # records) ran — a TrainingDiverged or mid-round iterator
+            # failure must still land its final records and must not
+            # leak the descriptor past the task (the PR-4 prefetcher
+            # leak class, applied to telemetry)
+            if self.net is not None:
+                self.net.metrics.close()
         return 0
 
 

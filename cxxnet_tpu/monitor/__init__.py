@@ -14,7 +14,14 @@ ThreadBuffer's.  This package is their TPU-era rework:
   scalars INSIDE the traced step (zero overhead when ``monitor = 0``:
   the step jaxpr is unchanged, asserted in tests);
 * :mod:`.trace` — pure-python profiler-trace (xplane.pb) parser shared
-  by bench.py, tools/trace_summary.py, and the profiling window.
+  by bench.py, tools/trace_summary.py, and the profiling window
+  (one-shot, step-addressed, or recurring via ``prof_every``);
+* :mod:`.attribution` — per-layer device-time attribution: joins the
+  trace's per-op times against the ``jax.named_scope`` layer stamps
+  (the ``layer_profile`` record, read by tools/obsv.py);
+* :mod:`.sentinel` — rolling-EWMA regression sentinels over step time /
+  comm share / HBM high-water (``anomaly`` records) plus the
+  flight-recorder ring dumped on anomalies and TrainingDiverged.
 
 See doc/monitor.md for the config surface and JSONL record schema.
 """

@@ -17,15 +17,27 @@ doc/monitor.md for the per-kind schema.
 from __future__ import annotations
 
 import json
+import math
+import random
 import time
-from typing import Any, Dict, Optional, TextIO
+from typing import Any, Dict, List, Optional, TextIO
 
 
 class Histogram:
-    """Streaming summary (count/sum/min/max + last) — enough to answer
-    "how long do dispatches take" without holding samples."""
+    """Streaming summary (count/sum/min/max/last + p50/p95/p99).
 
-    __slots__ = ("count", "total", "min", "max", "last")
+    Percentiles come from a bounded reservoir (uniform sample of
+    everything observed, ``_RESERVOIR`` values max, deterministic
+    replacement) — exact until the reservoir fills, an unbiased estimate
+    after, and never more than a few KB of host memory per series.  The
+    serving-telemetry consumer (``pred``/``extract`` per-batch latency,
+    the ``latency`` JSONL record — ROADMAP item 1) reads tail latency
+    through this."""
+
+    _RESERVOIR = 2048
+
+    __slots__ = ("count", "total", "min", "max", "last", "_samples",
+                 "_rng")
 
     def __init__(self):
         self.count = 0
@@ -33,6 +45,9 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.last: Optional[float] = None
+        self._samples: List[float] = []
+        # fixed seed: summaries must not vary run to run on equal input
+        self._rng = random.Random(0x5EED)
 
     def observe(self, value: float) -> None:
         v = float(value)
@@ -41,12 +56,34 @@ class Histogram:
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
         self.last = v
+        if len(self._samples) < self._RESERVOIR:
+            self._samples.append(v)
+        else:  # reservoir replacement: keep a uniform sample
+            j = self._rng.randrange(self.count)
+            if j < self._RESERVOIR:
+                self._samples[j] = v
+
+    @staticmethod
+    def _nearest_rank(s: List[float], q: float) -> float:
+        # nearest-rank: ceil(n*q/100) - 1, clamped to a valid index
+        i = max(math.ceil(len(s) * q / 100.0) - 1, 0)
+        return s[min(i, len(s) - 1)]
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 100]; nearest-rank over the reservoir."""
+        if not self._samples:
+            return None
+        return self._nearest_rank(sorted(self._samples), q)
 
     def summary(self) -> Dict[str, float]:
         out = {"count": self.count, "sum": self.total}
         if self.count:
+            s = sorted(self._samples)  # one sort feeds all three ranks
             out.update(min=self.min, max=self.max,
-                       mean=self.total / self.count, last=self.last)
+                       mean=self.total / self.count, last=self.last,
+                       p50=self._nearest_rank(s, 50),
+                       p95=self._nearest_rank(s, 95),
+                       p99=self._nearest_rank(s, 99))
         return out
 
 

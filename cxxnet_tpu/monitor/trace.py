@@ -12,7 +12,7 @@ container might not, and a 600 MB dependency for four varint fields is
 the wrong trade.  Field numbers verified against the installed proto:
 XSpace.planes=1; XPlane.name=2/lines=3/event_metadata=4 (map: key=1,
 value=2); XLine.name=2/events=4; XEvent.metadata_id=1/offset_ps=2/
-duration_ps=3; XEventMetadata.id=1/name=2.
+duration_ps=3; XEventMetadata.id=1/name=2/display_name=3.
 
 Collective classification: cross-chip reduction ops (all-reduce /
 reduce-scatter / all-gather / all-to-all / collective-permute, plus
@@ -92,13 +92,20 @@ class XLine:
 
 
 class XPlane:
-    __slots__ = ("name", "lines", "event_names")
+    __slots__ = ("name", "lines", "event_names", "event_display")
 
     def __init__(self, name: str, lines: List[XLine],
-                 event_names: Dict[int, str]):
+                 event_names: Dict[int, str],
+                 event_display: Optional[Dict[int, str]] = None):
         self.name = name
         self.lines = lines
         self.event_names = event_names
+        # XEventMetadata.display_name (field 3): TPU op events carry the
+        # framework op path here ("jit(step)/03-conv/conv_general_..."),
+        # which is where layer attribution reads named scopes from when
+        # the trace itself has them (monitor/attribution.py)
+        self.event_display = event_display if event_display is not None \
+            else {}
 
 
 def _parse_event(buf: bytes) -> XEvent:
@@ -123,9 +130,9 @@ def _parse_line(buf: bytes) -> XLine:
     return XLine(name, events)
 
 
-def _parse_event_metadata_entry(buf: bytes) -> Tuple[int, str]:
-    """map<int64, XEventMetadata> entry -> (id, name)."""
-    key, name = 0, ""
+def _parse_event_metadata_entry(buf: bytes) -> Tuple[int, str, str]:
+    """map<int64, XEventMetadata> entry -> (id, name, display_name)."""
+    key, name, display = 0, "", ""
     for field, _, val in _fields(buf):
         if field == 1:
             key = val
@@ -133,20 +140,24 @@ def _parse_event_metadata_entry(buf: bytes) -> Tuple[int, str]:
             for f2, _, v2 in _fields(val):
                 if f2 == 2:
                     name = v2.decode("utf-8", "replace")
-    return key, name
+                elif f2 == 3:
+                    display = v2.decode("utf-8", "replace")
+    return key, name, display
 
 
 def _parse_plane(buf: bytes) -> XPlane:
-    name, lines, event_names = "", [], {}
+    name, lines, event_names, event_display = "", [], {}, {}
     for field, _, val in _fields(buf):
         if field == 2:
             name = val.decode("utf-8", "replace")
         elif field == 3:
             lines.append(_parse_line(val))
         elif field == 4:
-            k, v = _parse_event_metadata_entry(val)
+            k, v, d = _parse_event_metadata_entry(val)
             event_names[k] = v
-    return XPlane(name, lines, event_names)
+            if d:
+                event_display[k] = d
+    return XPlane(name, lines, event_names, event_display)
 
 
 def parse_xspace(path: str) -> List[XPlane]:
@@ -326,10 +337,19 @@ def comm_report(path: str, steps: int = 1, plane_filter: str = "TPU",
                 line_filter: str = "XLA Ops") -> Dict[str, object]:
     """Per-step comm/compute attribution of one trace — the
     ``comm_sec`` / ``overlap_frac`` gauge source (doc/monitor.md) and
-    the bench ``--dp-scaling`` comm-share numbers.  Falls back to an
-    unfiltered plane scan when nothing matches ``plane_filter`` (CPU
-    runtime traces name their planes differently)."""
-    planes = parse_xspace(find_xplane(path))
+    the bench ``--dp-scaling`` comm-share numbers."""
+    return comm_report_in(parse_xspace(find_xplane(path)), steps,
+                          plane_filter, line_filter)
+
+
+def comm_report_in(planes: List[XPlane], steps: int = 1,
+                   plane_filter: str = "TPU",
+                   line_filter: str = "XLA Ops") -> Dict[str, object]:
+    """:func:`comm_report` over already-parsed planes (the profiling
+    window parses once and feeds both this and layer attribution).
+    Falls back to an unfiltered plane scan when nothing matches
+    ``plane_filter`` (CPU runtime traces name their planes
+    differently)."""
     device_ms = total_ms_in(planes, plane_filter)
     comm = comm_summary_in(planes, plane_filter, line_filter)
     if device_ms == 0.0 and comm["comm_ms"] == 0.0 and plane_filter:
@@ -361,44 +381,63 @@ class ProfileWindow:
     ``prof_num_steps`` steps (0 = to round end).  With the default
     ``prof_start_step = -1`` the legacy behavior holds — the window opens
     at the start of the round past compilation (the second round, or the
-    only round) — but ``prof_num_steps`` can now bound it.  One window
-    per run; all hooks are no-ops once it closed or when ``trace_dir``
-    is empty.
+    only round) — but ``prof_num_steps`` can now bound it.
+
+    ``every = N`` (``prof_every``, doc/monitor.md) turns the one-shot
+    window into a RECURRING one: a fresh window opens at the start of
+    every Nth round (first at the legacy prof round, past compilation),
+    each writing its trace under ``<trace_dir>/rNNNN`` so per-window
+    reports never read a stale xplane.  Each closed window leaves its
+    location/length in ``last_window_dir`` / ``last_window_steps`` for
+    the report emitters.  All hooks are no-ops when ``trace_dir`` is
+    empty, and — for one-shot windows — once the window closed.
     """
 
     def __init__(self, trace_dir: str, start_step: int = -1,
-                 num_steps: int = 0):
+                 num_steps: int = 0, every: int = 0):
         self.trace_dir = trace_dir
         self.start_step = start_step
         self.num_steps = num_steps
+        self.every = every
         self.active = False
         self.done = False
         self._steps_traced = 0
+        self.last_window_dir = ""
+        self.last_window_steps = 0
 
     @property
     def steps_traced(self) -> int:
         return self._steps_traced
 
-    def _start(self) -> None:
+    def _start(self, where: str) -> None:
         import jax
-        jax.profiler.start_trace(self.trace_dir)
+        jax.profiler.start_trace(where)
         self.active = True
+        self.last_window_dir = where
+        self._steps_traced = 0
 
     def maybe_start_round(self, rounds_done: int, prof_round: int) -> None:
-        """Round-boundary hook for the legacy whole-round window."""
-        if (self.trace_dir and self.start_step < 0 and not self.done
-                and not self.active and rounds_done == prof_round):
-            self._start()
+        """Round-boundary hook for whole-round windows (legacy one-shot
+        and the recurring ``prof_every`` cadence)."""
+        if not self.trace_dir or self.start_step >= 0 or self.active:
+            return
+        if self.every > 0:
+            if rounds_done >= prof_round \
+                    and (rounds_done - prof_round) % self.every == 0:
+                self._start(os.path.join(self.trace_dir,
+                                         f"r{rounds_done:04d}"))
+        elif not self.done and rounds_done == prof_round:
+            self._start(self.trace_dir)
 
     def maybe_start_step(self, global_step: int) -> None:
         """Pre-dispatch hook: opens a step-addressed window."""
         if (self.trace_dir and self.start_step >= 0 and not self.done
                 and not self.active and global_step >= self.start_step):
-            self._start()
+            self._start(self.trace_dir)
 
     def after_step(self) -> bool:
         """Post-dispatch hook; returns True when this step closed the
-        window (the caller logs the trace location)."""
+        window (the caller emits the trace report)."""
         if not self.active:
             return False
         self._steps_traced += 1
@@ -418,4 +457,6 @@ class ProfileWindow:
         import jax
         jax.profiler.stop_trace()
         self.active = False
-        self.done = True
+        self.last_window_steps = self._steps_traced
+        if not self.every:
+            self.done = True

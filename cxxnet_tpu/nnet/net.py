@@ -139,7 +139,7 @@ class Network:
         Node values are SSA: self-loop layers rebind their node's entry.
         """
         from .. import engine
-        from ..layers.base import materialize
+        from ..layers.base import conn_scope_name, materialize
         nodes: List[Optional[jnp.ndarray]] = [None] * self.cfg.num_nodes
         for nid, v in inputs.items():
             nodes[nid] = v.astype(self.dtype) if v.dtype != self.dtype else v
@@ -150,21 +150,29 @@ class Network:
         for i, conn in enumerate(self.connections):
             if i in fuse_skip:
                 continue
-            if fuse and i in fuse:
-                self._forward_fused(fuse[i], params, nodes)
-                continue
-            if virtual and self._virtual_forward(conn, params, nodes):
-                continue
-            ins = [materialize(nodes[n]) for n in conn.nindex_in]
-            p = conn_params(params, conn)
-            b = new_buffers.get(conn.param_key, {})
-            outs, nb = conn.layer.forward(p, b, ins, ctx)
-            # shared connections update the primary's buffer group too: the
-            # next invocation reads the chained update (last write wins)
-            if nb:
-                new_buffers[conn.param_key] = nb
-            for n, v in zip(conn.nindex_out, outs):
-                nodes[n] = v
+            # layer-attribution stamp: HLO op metadata (and so the
+            # profiler trace) carries this connection's identity through
+            # forward AND the jax.grad transpose (monitor/attribution.py
+            # joins per-op device times back to it).  Metadata only: the
+            # computation and the lowered program are unchanged, so the
+            # monitor=0 HLO-equality guarantee holds
+            with jax.named_scope(conn_scope_name(i, conn)):
+                if fuse and i in fuse:
+                    self._forward_fused(fuse[i], params, nodes)
+                    continue
+                if virtual and self._virtual_forward(conn, params, nodes):
+                    continue
+                ins = [materialize(nodes[n]) for n in conn.nindex_in]
+                p = conn_params(params, conn)
+                b = new_buffers.get(conn.param_key, {})
+                outs, nb = conn.layer.forward(p, b, ins, ctx)
+                # shared connections update the primary's buffer group
+                # too: the next invocation reads the chained update (last
+                # write wins)
+                if nb:
+                    new_buffers[conn.param_key] = nb
+                for n, v in zip(conn.nindex_out, outs):
+                    nodes[n] = v
         return nodes, new_buffers
 
     def _virtual_forward(self, conn, params, nodes) -> bool:

@@ -23,7 +23,7 @@ from typing import Callable, List, Tuple
 
 import jax.numpy as jnp
 
-from ..layers.base import ForwardContext, LabelInfo
+from ..layers.base import ForwardContext, LabelInfo, conn_scope_name
 from ..layers.conv import ConvolutionLayer
 from ..layers.fullc import FullConnectLayer
 from .net import conn_params
@@ -186,11 +186,15 @@ def make_stage_fns(net, stages, body_end, *, train: bool, epoch,
             nodes = dict(zip(in_nodes[s], acts))
             for j in range(s0, s1):
                 conn = net.connections[j]
-                ins = [nodes[n] for n in conn.nindex_in]
-                p = conn_params(params, conn)
-                outs, _ = conn.layer.forward(p, {}, ins, ctx)
-                for n, v in zip(conn.nindex_out, outs):
-                    nodes[n] = v
+                # same attribution stamp as Network.forward: remat,
+                # pipeline, and dp_overlap segments all build through
+                # here, so per-op trace times keep their layer identity
+                with jax.named_scope(conn_scope_name(j, conn)):
+                    ins = [nodes[n] for n in conn.nindex_in]
+                    p = conn_params(params, conn)
+                    outs, _ = conn.layer.forward(p, {}, ins, ctx)
+                    for n, v in zip(conn.nindex_out, outs):
+                        nodes[n] = v
             for l in ctx.losses:
                 loss_acc = loss_acc + l
             return (tuple(nodes[n] for n in out_nodes[s]), loss_acc, extra)

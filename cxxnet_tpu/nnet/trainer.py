@@ -909,12 +909,15 @@ class NetTrainer:
                              mesh=self.mesh if self.mesh.size > 1 else None)
         nodes = dict(nodes)
         from .net import conn_params
-        for conn in self.net.connections[body_end:]:
-            ins = [nodes[n] for n in conn.nindex_in]
-            p = conn_params(params, conn)
-            outs, _ = conn.layer.forward(p, {}, ins, ctx)
-            for n, v in zip(conn.nindex_out, outs):
-                nodes[n] = v
+        from ..layers.base import conn_scope_name
+        for j, conn in enumerate(self.net.connections[body_end:],
+                                 start=body_end):
+            with jax.named_scope(conn_scope_name(j, conn)):
+                ins = [nodes[n] for n in conn.nindex_in]
+                p = conn_params(params, conn)
+                outs, _ = conn.layer.forward(p, {}, ins, ctx)
+                for n, v in zip(conn.nindex_out, outs):
+                    nodes[n] = v
         if body_loss is not None:
             # unconditional: a net whose loss layers are ALL mid-body has
             # an empty tail, and its entire training loss is the threaded
@@ -1725,6 +1728,70 @@ class NetTrainer:
         """HBM high-water gauges over this trainer's devices (empty on
         backends without memory_stats, e.g. CPU)."""
         return device_memory_gauges(self.devices)
+
+    # -------------------------------------------------- layer attribution
+    def layer_scopes(self) -> List[str]:
+        """The named-scope strings the net builder stamps each
+        connection's forward with — the join keys layer attribution
+        (monitor/attribution.py, doc/monitor.md) matches against
+        profiler-trace op metadata."""
+        from ..layers.base import conn_scope_name
+        return [conn_scope_name(i, c)
+                for i, c in enumerate(self.net.connections)]
+
+    def step_hlo_text(self, optimized: bool = True) -> Optional[str]:
+        """Optimized-HLO text of the compiled train step (AOT-lowered
+        from abstract args matching :meth:`update`'s operands), or None
+        when this trainer's executed program can't be reproduced that
+        way (input_s2d staging shapes, the dp_reduce_at=apply two-step
+        path) or lowering fails.  Layer attribution reads each
+        instruction's ``op_name`` metadata out of this text to map
+        post-fusion trace op names back to layer scopes.
+
+        Cost note: the AOT ``lower().compile()`` pays one extra XLA
+        compile (the jit execution cache is keyed separately).  Callers
+        gate it behind a closed profiling window with an active metrics
+        sink, and the text is cached per trainer, so recurring
+        ``prof_every`` windows compile once."""
+        cached = getattr(self, "_step_hlo_cache", None)
+        if cached is not None:
+            return cached or None  # "" caches a permanent failure
+        if self._s2d_args is not None \
+                or getattr(self, "_overlap_defer", False):
+            self._step_hlo_cache = ""
+            return None
+        try:
+            sds = jax.ShapeDtypeStruct
+            absify = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: sds(x.shape, x.dtype), t)
+            shp = self.net.node_shapes[0]
+            label_w = max([b for _, _, b in self._label_fields], default=1)
+            data = sds((self.batch_size,) + tuple(shp[1:]), np.float32)
+            label = sds((self.batch_size, label_w), np.float32)
+            extras = tuple(
+                sds((self.batch_size,)
+                    + tuple(self.net.node_shapes[1 + i][1:]), np.float32)
+                for i in range(self.netcfg.extra_data_num))
+            p, o, bu = (absify(self.params), absify(self.opt_state),
+                        absify(self.buffers))
+            epoch = sds((), np.int32)
+            rng = jax.random.PRNGKey(0)
+            if self.update_period > 1:
+                lowered = self._train_step.lower(
+                    p, o, bu, absify(self.params), data, label, extras,
+                    epoch, rng, sds((), np.bool_))
+            else:
+                lowered = self._train_step.lower(
+                    p, o, bu, data, label, extras, epoch, rng)
+            txt = lowered.compile().as_text() if optimized \
+                else lowered.as_text()
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            mlog.warn(f"step_hlo_text: lowering failed ({e}); layer "
+                      "attribution will report unattributed time only")
+            self._step_hlo_cache = ""
+            return None
+        self._step_hlo_cache = txt
+        return txt
 
     def accumulate_train_metric(self, outs, label, n_padd: int = 0) -> None:
         """Add one batch's eval-node outputs to the train metric (shared by

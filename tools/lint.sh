@@ -4,11 +4,16 @@
 #      findings required (the key registry and the configs must agree;
 #      tests/test_analysis.py mirrors this as the golden guard);
 #   2. the pytest collection guard — import breaks must not hide behind
-#      tier-1's --continue-on-collection-errors.
+#      tier-1's --continue-on-collection-errors;
+#   3. the run-report CLI over the checked-in metrics fixture — a schema
+#      drift between the sink's record kinds and tools/obsv.py's parser
+#      breaks loudly here, not in the middle of a perf triage.
 # Companion to tools/tier1.sh (the runtime gate); see doc/check.md.
 cd "$(dirname "$0")/.." || exit 1
 set -e
 env JAX_PLATFORMS=cpu python tools/graftlint.py example/*/*.conf
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only \
     -p no:cacheprovider >/dev/null
+env JAX_PLATFORMS=cpu python tools/obsv.py tests/fixtures/run_report.jsonl \
+    --json >/dev/null
 echo "lint OK"

@@ -17,6 +17,16 @@ XEventMetadata.id=1/name=2).  The fixture carries:
   round-5 "copy-done" bug class, BASELINE.md round 5);
 * a host plane the default TPU filters must exclude (7 ms).
 
+The compute ops carry XEventMetadata.display_name framework-op paths
+with the NN-name scopes the net builder stamps (layers/base.py
+conn_scope_name) — convolution.3's path is wrapped in
+``transpose(jvp(...))`` the way jax.grad transposes render, so layer
+attribution's substring matching (monitor/attribution.py) is exercised;
+collectives and the module event carry none.  Expected attribution with
+scopes {00-conv, 03-fullc}: 00-conv 4.5 ms (fusion.1 x2 +
+convolution.3), 03-fullc 0.8 ms (copy.2 + the trap fusion),
+(collectives) 0.8 ms.
+
 Run from the repo root:  python tools/make_xplane_fixture.py
 """
 
@@ -61,17 +71,21 @@ def line(name: str, events: list) -> bytes:
     return out
 
 
-def metadata_entry(mid: int, name: str) -> bytes:
+def metadata_entry(mid: int, name: str, display: str = "") -> bytes:
     meta = _field_varint(1, mid) + _field_len(2, name.encode())
+    if display:
+        meta += _field_len(3, display.encode())
     return _field_varint(1, mid) + _field_len(2, meta)
 
 
-def plane(name: str, lines: list, names: dict) -> bytes:
+def plane(name: str, lines: list, names: dict, displays: dict = None
+          ) -> bytes:
     out = _field_len(2, name.encode())
     for ln in lines:
         out += _field_len(3, ln)
     for mid, nm in sorted(names.items()):
-        out += _field_len(4, metadata_entry(mid, nm))
+        out += _field_len(4, metadata_entry(
+            mid, nm, (displays or {}).get(mid, "")))
     return out
 
 
@@ -80,6 +94,12 @@ def build() -> bytes:
         1: "fusion.1", 2: "copy.2", 3: "convolution.3", 4: "jit_step",
         5: "all-reduce-start.1", 6: "all-reduce-done.1",
         7: "reduce-scatter.2", 8: "loop-all-reduce-fusion.3",
+    }
+    tpu_displays = {
+        1: "jit(step)/jit(main)/00-conv/add.1",
+        2: "jit(step)/03-fullc/copy",
+        3: "jit(step)/transpose(jvp(00-conv))/conv_general_dilated",
+        8: "jit(step)/03-fullc/while/body/add",
     }
     tpu = plane("/device:TPU:0", [
         line("XLA Modules", [event(4, 5 * MS)]),
@@ -93,7 +113,7 @@ def build() -> bytes:
             event(7, 2 * MS // 5, 8 * MS),        # sync reduce-scatter
             event(8, 3 * MS // 5, 9 * MS),        # the substring trap
         ]),
-    ], tpu_names)
+    ], tpu_names, tpu_displays)
     host = plane("/host:CPU", [
         line("XLA Ops", [event(1, 7 * MS)]),
     ], {1: "host-loop"})

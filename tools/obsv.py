@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""obsv: one-command run report from a metrics JSONL (+ optional trace).
+
+The training observatory's read side (doc/monitor.md "Reading a run
+report"): point it at the ``metrics_sink`` file of any run and get the
+throughput trend, the compile/comm/idle breakdown, the top-k layers by
+attributed device time with roofline distance, inference latency
+percentiles, and every anomaly the sentinels fired — as aligned
+terminal tables or one ``--json`` object for CI.
+
+    python tools/obsv.py metrics.jsonl
+    python tools/obsv.py metrics.jsonl --json | jq .layers
+    python tools/obsv.py metrics.jsonl --top 20
+    python tools/obsv.py metrics.jsonl --trace /tmp/prof   # re-attribute
+
+``--trace`` re-runs layer attribution directly on a profiler trace via
+the scope paths embedded in its op metadata (TPU traces; CPU-runtime
+traces carry none — there the in-run ``layer_profile`` record, which
+joins through the compiled HLO, is the authoritative table).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_records(path: str) -> List[dict]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a killed run
+            if isinstance(r, dict) and "kind" in r:
+                recs.append(r)
+    return recs
+
+
+def _by_kind(recs: List[dict]) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    for r in recs:
+        out.setdefault(r["kind"], []).append(r)
+    return out
+
+
+def build_report(recs: List[dict], top: int = 10) -> dict:
+    by = _by_kind(recs)
+    rep: dict = {"n_records": len(recs),
+                 "kinds": {k: len(v) for k, v in sorted(by.items())}}
+    if by.get("run"):
+        run = by["run"][-1]
+        rep["run"] = {k: run.get(k) for k in
+                      ("updater", "batch_size", "dtype", "mesh",
+                       "monitor") if k in run}
+    if by.get("compile"):
+        rep["compile_sec"] = by["compile"][-1].get("compile_sec")
+
+    steps = by.get("step", [])
+    if steps:
+        eps = [r["examples_per_sec"] for r in steps
+               if r.get("examples_per_sec")]
+        if eps:
+            rep["throughput"] = {
+                "windows": len(eps),
+                "first": eps[0], "last": eps[-1],
+                "best": max(eps), "worst": min(eps),
+                "mean": round(sum(eps) / len(eps), 1),
+                "last_vs_best": round(eps[-1] / max(eps), 3),
+            }
+
+    rounds = by.get("round", [])
+    if rounds:
+        rep["rounds"] = [
+            {k: r.get(k) for k in
+             ("round", "examples_per_sec", "wall_sec", "eval_sec",
+              "iter_wait_sec", "dispatch_sec", "h2d_sec",
+              "hbm_peak_bytes", "train_step_traces") if k in r}
+            for r in rounds]
+        wall = sum(r.get("wall_sec", 0.0) for r in rounds)
+        disp = sum(r.get("dispatch_sec", 0.0) for r in rounds)
+        wait = sum(r.get("iter_wait_sec", 0.0) for r in rounds)
+        rep["breakdown"] = {
+            "train_wall_sec": round(wall, 3),
+            "dispatch_sec": round(disp, 3),
+            "iter_wait_sec": round(wait, 3),
+            "h2d_sec": round(sum(r.get("h2d_sec", 0.0)
+                                 for r in rounds), 3),
+            "eval_sec": round(sum(r.get("eval_sec", 0.0)
+                                  for r in rounds), 3),
+            # loop wall the host spent neither dispatching nor blocked
+            # on input: metric math, logging, staging bookkeeping
+            "other_sec": round(max(wall - disp - wait, 0.0), 3),
+            "compile_sec": rep.get("compile_sec"),
+        }
+
+    if by.get("trace"):
+        t = by["trace"][-1]
+        rep["comm"] = {k: t.get(k) for k in
+                       ("round", "steps", "device_sec", "comm_sec",
+                        "comm_share", "overlap_frac", "comm_by_kind")
+                       if k in t}
+    if by.get("layer_profile"):
+        lp = by["layer_profile"][-1]
+        rep["layers"] = {
+            "round": lp.get("round"),
+            "device_total_ms": lp.get("device_total_ms"),
+            "attributed_ms": lp.get("attributed_ms"),
+            "coverage": lp.get("coverage"),
+            "rows": (lp.get("rows") or [])[:top],
+            "dropped_rows": max(len(lp.get("rows") or []) - top, 0),
+        }
+    if by.get("latency"):
+        rep["latency"] = [
+            {k: r.get(k) for k in
+             ("op", "count", "mean", "p50", "p95", "p99", "max", "unit")
+             if k in r} for r in by["latency"]]
+    if by.get("anomaly"):
+        rep["anomalies"] = [
+            {k: r.get(k) for k in
+             ("metric", "direction", "value", "ewma", "rel_dev",
+              "round", "step") if k in r} for r in by["anomaly"]]
+    rep["flights"] = len(by.get("flight", []))
+    if by.get("nan"):
+        rep["nonfinite_steps"] = len(by["nan"])
+    return rep
+
+
+# ----------------------------------------------------------- rendering
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:>{w}}}" for w in widths)
+    lines = [fmt.format(*headers)]
+    for r in rows:
+        lines.append(fmt.format(*r))
+    return "\n".join(lines)
+
+
+def render(rep: dict) -> str:
+    out = []
+    run = rep.get("run")
+    if run:
+        out.append("run: " + "  ".join(f"{k}={v}" for k, v in run.items()))
+    th = rep.get("throughput")
+    if th:
+        out.append(
+            f"throughput: last {_fmt(th['last'], 1)} ex/s over "
+            f"{th['windows']} windows (best {_fmt(th['best'], 1)}, "
+            f"mean {_fmt(th['mean'], 1)}; last/best "
+            f"{th['last_vs_best']:.0%})")
+    bd = rep.get("breakdown")
+    if bd:
+        out.append("breakdown (train wall "
+                   f"{_fmt(bd['train_wall_sec'])} s): "
+                   f"dispatch {_fmt(bd['dispatch_sec'])} s, "
+                   f"input wait {_fmt(bd['iter_wait_sec'])} s, "
+                   f"other {_fmt(bd['other_sec'])} s; "
+                   f"h2d {_fmt(bd['h2d_sec'])} s, "
+                   f"eval {_fmt(bd['eval_sec'])} s, "
+                   f"compile {_fmt(bd.get('compile_sec'))} s")
+    rounds = rep.get("rounds")
+    if rounds:
+        out.append("")
+        out.append(_table(
+            ["round", "ex/s", "wall_s", "eval_s", "wait_s", "hbm_peak"],
+            [[_fmt(r.get("round")), _fmt(r.get("examples_per_sec"), 1),
+              _fmt(r.get("wall_sec")), _fmt(r.get("eval_sec")),
+              _fmt(r.get("iter_wait_sec")),
+              _fmt(r.get("hbm_peak_bytes"))] for r in rounds]))
+    comm = rep.get("comm")
+    if comm:
+        kinds = ", ".join(f"{k} {_fmt(ms)} ms" for k, ms in
+                          (comm.get("comm_by_kind") or {}).items())
+        out.append("")
+        out.append(
+            f"comm (round {comm.get('round')}, {comm.get('steps')} "
+            f"steps): share {_fmt(comm.get('comm_share'))}, overlap "
+            f"{_fmt(comm.get('overlap_frac'))}"
+            + (f" [{kinds}]" if kinds else ""))
+    lp = rep.get("layers")
+    if lp:
+        out.append("")
+        out.append(
+            f"layers (round {lp.get('round')}): "
+            f"{_fmt(lp.get('attributed_ms'))} of "
+            f"{_fmt(lp.get('device_total_ms'))} ms/step attributed "
+            f"(coverage {_fmt(lp.get('coverage'))})")
+        rows = [[r.get("layer", "?"), _fmt(r.get("device_ms")),
+                 _fmt(r.get("share")), _fmt(r.get("comm_ms")),
+                 _fmt(r.get("mfu_pct"), 1), _fmt(r.get("roofline_ms")),
+                 _fmt(r.get("roofline_x"), 1)]
+                for r in lp.get("rows") or []]
+        if rows:
+            out.append(_table(
+                ["layer", "ms/step", "share", "comm_ms", "mfu%",
+                 "roofline_ms", "x_roof"], rows))
+        if lp.get("dropped_rows"):
+            out.append(f"... {lp['dropped_rows']} more rows "
+                       "(--top to widen)")
+    lat = rep.get("latency")
+    if lat:
+        out.append("")
+        out.append(_table(
+            ["op", "count", "mean_ms", "p50", "p95", "p99", "max_ms"],
+            [[r.get("op", "?"), _fmt(r.get("count")),
+              _fmt(r.get("mean")), _fmt(r.get("p50")),
+              _fmt(r.get("p95")), _fmt(r.get("p99")),
+              _fmt(r.get("max"))] for r in lat]))
+    anoms = rep.get("anomalies")
+    if anoms:
+        out.append("")
+        out.append(f"anomalies: {len(anoms)} "
+                   f"(flight dumps: {rep.get('flights', 0)})")
+        out.append(_table(
+            ["metric", "dir", "value", "ewma", "rel_dev", "round",
+             "step"],
+            [[r.get("metric", "?"), r.get("direction", "?"),
+              _fmt(r.get("value")), _fmt(r.get("ewma")),
+              _fmt(r.get("rel_dev")), _fmt(r.get("round")),
+              _fmt(r.get("step"))] for r in anoms]))
+    elif rep.get("kinds", {}).get("step"):
+        out.append("")
+        out.append("anomalies: none")
+    if rep.get("nonfinite_steps"):
+        out.append(f"NON-FINITE LOSS steps: {rep['nonfinite_steps']}")
+    return "\n".join(out)
+
+
+def trace_report(path: str, top: int) -> dict:
+    """Standalone re-attribution of a trace by its embedded scope paths
+    (no trainer, no HLO join — see module docstring)."""
+    from cxxnet_tpu.monitor import attribution
+    from cxxnet_tpu.monitor.trace import (comm_report_in, find_xplane,
+                                          parse_xspace)
+    xplane = find_xplane(path)
+    planes = parse_xspace(xplane)
+    scopes = attribution.scopes_from_planes(planes)
+    table = attribution.layer_table(planes, scopes)
+    table["rows"] = table["rows"][:top]
+    return {"trace": xplane, "scopes_found": len(scopes),
+            "comm": comm_report_in(planes), "layers": table}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run report from a metrics JSONL (+ optional trace)")
+    ap.add_argument("jsonl", help="metrics_sink JSONL file")
+    ap.add_argument("--trace", default="",
+                    help="profiler log dir / xplane.pb: re-attribute "
+                    "per-layer device time from the trace's own scope "
+                    "metadata")
+    ap.add_argument("--top", type=int, default=10,
+                    help="layer rows to show")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON object instead of tables")
+    args = ap.parse_args(argv)
+    try:
+        recs = load_records(args.jsonl)
+    except OSError as e:
+        print(f"obsv: {e}", file=sys.stderr)
+        return 1
+    if not recs:
+        print(f"obsv: no records in {args.jsonl}", file=sys.stderr)
+        return 1
+    rep = build_report(recs, top=args.top)
+    if args.trace:
+        try:
+            rep["trace_reattribution"] = trace_report(args.trace,
+                                                      args.top)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"obsv: trace: {e}", file=sys.stderr)
+            return 1
+    if args.json:
+        print(json.dumps(rep))
+        return 0
+    print(render(rep))
+    tr = rep.get("trace_reattribution")
+    if tr:
+        # a bare trace dir carries no dispatch count, so these are
+        # whole-window totals — unlike the layer_profile table above,
+        # whose ms/step divides by the window's traced dispatches
+        print(f"\ntrace re-attribution ({tr['trace']}, "
+              f"{tr['scopes_found']} scopes; window totals):")
+        rows = [[r.get("layer", "?"), _fmt(r.get("device_ms")),
+                 _fmt(r.get("share")), _fmt(r.get("comm_ms"))]
+                for r in tr["layers"]["rows"]]
+        if rows:
+            print(_table(["layer", "ms/window", "share", "comm_ms"],
+                         rows))
+        else:
+            print("  (no scope metadata in this trace — use the run's "
+                  "layer_profile record instead)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
