@@ -327,6 +327,148 @@ netconfig=end
 """
 
 
+#: serve-bench model: the io-ab conv net at 24x24 (default), or a tiny
+#: MLP under --tiny (CI smoke); random init — the load generator
+#: measures the serving plumbing, not model quality
+SERVE_TINY_NET = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 10
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,64
+"""
+
+
+def bench_serve(argv=None) -> dict:
+    """``--serve``: closed-loop load generator over the serving
+    subsystem (serve/, doc/serve.md).  Sweeps offered QPS: per point,
+    ``clients`` paced threads submit single-row requests through the
+    micro-batcher for ``duration`` seconds, and the payload reports
+    achieved QPS, p50/p95/p99 latency, and the batch-size histogram the
+    coalescer produced — the curve that shows batching depth (and
+    throughput) rising with load while tail latency stays bounded by
+    ``serve_max_wait_ms``.  Overridable ``key=value`` args: ``dev``,
+    ``offered_qps`` (csv), ``duration`` (sec/point), ``clients``,
+    ``serve_shapes``, ``serve_dtype``, ``serve_max_wait_ms``;
+    ``--tiny``/``tiny=1`` swaps in a small MLP and a short sweep for CI
+    smokes."""
+    import threading
+
+    from cxxnet_tpu.serve import ServeConfig, parse_shapes
+    from cxxnet_tpu.serve.host import ServeModel
+    from __graft_entry__ import _make_trainer
+    args = dict(a.split("=", 1) for a in (argv or []) if "=" in a)
+    tiny = args.get("tiny") == "1" or "--tiny" in (argv or [])
+    dev = args.get("dev", "tpu")
+    duration = float(args.get("duration", "0.5" if tiny else "2.0"))
+    clients = int(args.get("clients", "4" if tiny else "8"))
+    qps_list = [float(q) for q in args.get(
+        "offered_qps", "200" if tiny else "100,400,1600").split(",")]
+    cfg = ServeConfig(
+        shapes=tuple(parse_shapes(args.get("serve_shapes",
+                                           "1,8" if tiny else "1,8,32"))),
+        max_wait_ms=float(args.get("serve_max_wait_ms", "2.0")),
+        dtype=args.get("serve_dtype", "f32"))
+    if tiny:
+        t = _make_trainer(SERVE_TINY_NET + "eta = 0.1\nsilent = 1\n",
+                          max(cfg.shapes), dev)
+        in_shape = (1, 1, 64)
+    else:
+        side = 24
+        t = _make_trainer(
+            IO_AB_NET + f"input_shape = 1,{side},{side}\n"
+            "eta = 0.1\nsilent = 1\n", max(cfg.shapes), dev)
+        in_shape = (1, side, side)
+    sm = ServeModel(t, cfg, name="bench")
+    t0 = time.perf_counter()
+    sm.warmup()
+    warmup_sec = time.perf_counter() - t0
+    rnd = np.random.RandomState(0)
+    pool = rnd.randn(256, *in_shape).astype(np.float32)
+    points = []
+    try:
+        for qps in qps_list:
+            lats, errs = [], []
+            lock = threading.Lock()
+            hist0 = dict(sm.batcher.batch_hist)
+            t_start = time.perf_counter()
+
+            def client(cid, rate):
+                # closed-loop pacing: each client schedules its next
+                # send at 1/rate and, once latency exceeds the interval,
+                # naturally degrades to back-to-back (saturation)
+                my = []
+                nxt = time.perf_counter()
+                while True:
+                    now = time.perf_counter()
+                    if now - t_start >= duration:
+                        break
+                    if now < nxt:
+                        time.sleep(min(nxt - now, 0.005))
+                        continue
+                    nxt = max(nxt + 1.0 / rate, now)
+                    i = (cid * 37 + len(my)) % pool.shape[0]
+                    rt0 = time.perf_counter()
+                    try:
+                        sm.predict(pool[i:i + 1])
+                    except BaseException as e:  # noqa: BLE001
+                        errs.append(e)
+                        return
+                    my.append((time.perf_counter() - rt0) * 1e3)
+                with lock:
+                    lats.extend(my)
+
+            threads = [threading.Thread(target=client,
+                                        args=(j, qps / clients),
+                                        daemon=True)
+                       for j in range(clients)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t_start
+            if errs:
+                raise errs[0]
+            hist = {k: v - hist0.get(k, 0)
+                    for k, v in sm.batcher.batch_hist.items()
+                    if v - hist0.get(k, 0)}
+            n = len(lats)
+            ls = np.sort(np.asarray(lats)) if n else np.zeros(1)
+            rows = sum(k * v for k, v in hist.items())
+            points.append({
+                "offered_qps": qps,
+                "achieved_qps": round(n / max(wall, 1e-9), 1),
+                "requests": n,
+                "p50_ms": round(float(np.percentile(ls, 50)), 3),
+                "p95_ms": round(float(np.percentile(ls, 95)), 3),
+                "p99_ms": round(float(np.percentile(ls, 99)), 3),
+                "mean_batch": round(rows / max(sum(hist.values()), 1), 2),
+                "batch_hist": {str(k): v for k, v in sorted(hist.items())},
+            })
+            print(f"bench: serve qps={qps:g} -> "
+                  f"{points[-1]['achieved_qps']} req/s p50="
+                  f"{points[-1]['p50_ms']}ms p95={points[-1]['p95_ms']}ms "
+                  f"mean_batch={points[-1]['mean_batch']}",
+                  file=sys.stderr)
+    finally:
+        sm.close()
+    return {
+        "metric": "serve_p95_ms",
+        "value": points[-1]["p95_ms"] if points else 0.0,
+        "unit": "ms",
+        "dtype": cfg.dtype,
+        "shapes": list(cfg.shapes),
+        "clients": clients,
+        "warmup_sec": round(warmup_sec, 3),
+        "retraces": sm.retraces,
+        "points": points,
+    }
+
+
 def bench_io_ab(argv=None) -> dict:
     """``--io-ab``: input-pipeline A/B at the device boundary — the
     ``test_io=1`` twin that KEEPS the device work.  Trains the same small
@@ -907,6 +1049,7 @@ BENCH_MODES = {
     "--opt-ab": bench_opt_ab,
     "--dp-scaling": bench_dp_scaling,
     "--io-ab": bench_io_ab,
+    "--serve": bench_serve,
 }
 
 

@@ -347,17 +347,57 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                     f"task = {task} but the config has no 'data = ...' "
                     "iterator section (fine for bench/netconfig-only "
                     "configs; task = train will fail at init)"))
-    if task in ("pred", "pred_raw", "extract"):
+    if task in ("pred", "pred_raw", "extract", "serve"):
         if sections_seen.get(3, 0) == 0:
             add(Finding("error", "pred",
                         f"task = {task} requires a 'pred = <out>' "
-                        "iterator section"))
+                        "iterator section"
+                        + (" (the request stream)"
+                           if task == "serve" else "")))
         if last.get("model_in", "NULL") == "NULL":
             add(Finding("error", "model_in",
-                        f"task = {task} requires model_in"))
+                        f"task = {task} requires model_in "
+                        + ("(a model snapshot to serve)"
+                           if task == "serve" else "")))
         if task == "extract" and not last.get("extract_node_name", ""):
             add(Finding("error", "extract_node_name",
                         "task = extract requires extract_node_name"))
+    _serve_rules(last, task, add)
+
+
+def _serve_rules(last: Dict[str, str], task: str, add) -> None:
+    """Cross-key rules for the serving subsystem (doc/serve.md).  The
+    ``serve_shapes`` value itself (sorted/positive) is validated by its
+    KeySpec check (serve.shapes_check), so a malformed spec is already
+    an error before these rules run."""
+    if task != "serve":
+        for k in ("serve_shapes", "serve_max_batch", "serve_max_wait_ms",
+                  "serve_dtype", "serve_clients", "serve_calib",
+                  "serve_queue_depth"):
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect without task = serve"))
+                break
+        return
+    if last.get("serve_dtype", "f32") == "int8" \
+            and _as_int(last, "serve_calib", 0) <= 0:
+        add(Finding("warn", "serve_dtype",
+                    "serve_dtype = int8 without calibration batches "
+                    "(serve_calib = N): the quantized variant ships "
+                    "without its pairtest-vs-f32 error being measured "
+                    "on real request data"))
+    shapes_str = last.get("serve_shapes", "")
+    if shapes_str:
+        from ..serve import shapes_check
+        if shapes_check(shapes_str) is None:
+            buckets = [int(p) for p in shapes_str.split(",") if p.strip()]
+            mb = _as_int(last, "serve_max_batch", 0)
+            if mb > max(buckets):
+                add(Finding("warn", "serve_max_batch",
+                            f"serve_max_batch = {mb} exceeds the largest "
+                            f"bucket ({max(buckets)}); coalescing caps at "
+                            "the bucket and larger requests split across "
+                            "dispatches"))
 
 
 def _mesh_rules(last: Dict[str, str], layer_types: List[str],

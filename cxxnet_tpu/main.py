@@ -4,7 +4,8 @@ Reference: ``src/cxxnet_main.cpp`` (CXXNetLearnTask).  Usage parity:
 
     python -m cxxnet_tpu <config.conf> [key=value ...]
 
-Tasks: ``task = train | finetune | pred | pred_raw | extract``; snapshots
+Tasks: ``task = train | finetune | pred | pred_raw | extract | serve |
+check``; snapshots
 ``model_dir/%04d.model`` every ``save_model`` rounds; ``continue = 1``
 resumes from the newest snapshot (SyncLastestModel, cxxnet_main.cpp:135-157);
 ``test_io = 1`` runs the loop without Update (I/O benchmark mode, :363-389).
@@ -21,6 +22,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .analysis.schema import K
+from .serve import SERVE_KEYS
 from .io.device_prefetch import DevicePrefetcher, StagedGroup, item_h2d_sec
 from .io.factory import create_iterator, init_iterator
 from .monitor import log as mlog
@@ -40,7 +42,7 @@ TASK_KEYS = (
     K("num_round", "int", lo=0), K("max_round", "int", lo=0),
     K("silent", "int", lo=0, hi=1),
     K("task", "enum", choices=("train", "finetune", "pred", "pred_raw",
-                               "extract", "check")),
+                               "extract", "check", "serve")),
     K("dev", "str"),
     K("test_io", "int", lo=0, hi=1),
     K("multi_step", "int", lo=0),
@@ -70,7 +72,9 @@ TASK_KEYS = (
     K("dist_coordinator", "str"),
     K("dist_num_proc", "int", lo=1),
     K("dist_proc_rank", "int", lo=0),
-)
+    # serving keys (serve/__init__.py declares them next to their
+    # consumer, ServeConfig.from_pairs; doc/serve.md)
+) + SERVE_KEYS
 
 
 class LearnTask:
@@ -315,7 +319,8 @@ class LearnTask:
                 if flag == 2 and self.task != "pred":
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
-                if flag == 3 and self.task in ("pred", "pred_raw", "extract"):
+                if flag == 3 and self.task in ("pred", "pred_raw",
+                                               "extract", "serve"):
                     assert self.itr_pred is None, "can only have one pred data"
                     self.itr_pred = create_iterator(itcfg)
                 flag = 0
@@ -965,6 +970,169 @@ class LearnTask:
             self._close_prefetchers()
         mlog.notice(f"finished extraction, write into {self.name_pred}")
 
+    def task_serve(self) -> None:
+        """``task = serve``: host the loaded model behind the dynamic
+        micro-batching predict engine and replay the ``pred`` iterator
+        as a concurrent request stream — ``serve_clients`` threads each
+        submitting single-row requests, the batcher coalescing them into
+        shape-bucket dispatches (doc/serve.md).  Predictions land in
+        ``name_pred`` exactly like ``task = pred``; the run emits the
+        serving telemetry the observatory reads (one ``latency`` record
+        with p50/p95/p99, a ``serve`` record with QPS / batch-size
+        histogram / queue-depth stats, and the retrace gauge)."""
+        assert self.itr_pred is not None, (
+            "task=serve requires a 'pred = <out>' iterator section "
+            "(the request stream)")
+        from .serve import ServeConfig
+        from .serve.host import ServeModel
+        cfg = ServeConfig.from_pairs(self.cfg)
+        metrics = self.net.metrics
+        sm = ServeModel(self.net, cfg, metrics=metrics)
+        mlog.notice(
+            f"serve: warming {len(cfg.shapes)} shape bucket(s) "
+            f"{list(cfg.shapes)}, dtype={cfg.dtype} ...")
+        sm.warmup()
+        mlog.info(f"serve: warmup compiled in {sm.engine.warmup_sec:.1f} "
+                  "sec")
+        # quantization pairtest on real request data (doc/serve.md):
+        # the measured side of the declared SERVE_TOL envelope, run on
+        # the first serve_calib batches before serving starts
+        if cfg.dtype != "f32" and cfg.calib > 0:
+            calib_rows: List[np.ndarray] = []
+            self.itr_pred.before_first()
+            while len(calib_rows) < cfg.calib:
+                batch = self.itr_pred.next()
+                if batch is None:
+                    break
+                calib_rows.append(np.array(
+                    batch.data[:batch.batch_size - batch.num_batch_padd],
+                    np.float32))
+            if calib_rows:
+                err = max(sm.engine.pairtest(r) for r in calib_rows)
+                metrics.set_gauge("serve_quant_rel_err", err)
+                from .serve.engine import SERVE_TOL
+                mlog.result(
+                    f"serve: {cfg.dtype} pairtest vs f32 on "
+                    f"{len(calib_rows)} calibration batch(es): max rel "
+                    f"err {err:.3g} (envelope {SERVE_TOL[cfg.dtype]:g})")
+        # stream the request iterator: each VALID row of each pred batch
+        # becomes one single-row request (round_batch padding excluded,
+        # like predict_raw) fed through a BOUNDED work queue — the
+        # batcher, not the file layout, decides the dispatch batching,
+        # and host memory stays O(queue), not O(dataset) (task=pred's
+        # streaming discipline)
+        mlog.notice(f"serve: streaming requests over {cfg.clients} "
+                    "client thread(s)")
+        import queue as _queue
+        import threading
+        results: dict = {}          # idx -> raw output rows
+        errors: List[BaseException] = []
+        abort = threading.Event()
+        work: "_queue.Queue" = _queue.Queue(
+            maxsize=max(cfg.queue_depth, 2 * cfg.max_batch))
+        _DONE = object()
+        n_total = [0]
+
+        def _put(item) -> bool:
+            while not abort.is_set():
+                try:
+                    work.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                self.itr_pred.before_first()
+                idx = 0
+                while True:
+                    batch = self.itr_pred.next()
+                    if batch is None:
+                        break
+                    valid = np.array(
+                        batch.data[:batch.batch_size
+                                   - batch.num_batch_padd], np.float32)
+                    for i in range(valid.shape[0]):
+                        if not _put((idx, valid[i:i + 1])):
+                            return
+                        idx += 1
+                n_total[0] = idx
+            except BaseException as e:  # noqa: BLE001 — reported below
+                errors.append(e)
+                abort.set()
+            finally:
+                for _ in range(cfg.clients):
+                    if not _put(_DONE):
+                        return
+
+        def client():
+            while True:
+                try:
+                    item = work.get(timeout=0.05)
+                except _queue.Empty:
+                    if abort.is_set():
+                        return
+                    continue
+                if item is _DONE:
+                    return
+                i, row = item
+                try:
+                    results[i] = sm.predict(row)
+                except BaseException as e:  # noqa: BLE001 — reported below
+                    errors.append(e)
+                    abort.set()
+                    return
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, daemon=True,
+                                    name=f"cxxnet-serve-client-{j}")
+                   for j in range(cfg.clients)]
+        prod = threading.Thread(target=producer, daemon=True,
+                                name="cxxnet-serve-producer")
+        try:
+            prod.start()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            prod.join()
+            dur = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            with open(self.name_pred, "w") as fo:
+                for i in range(n_total[0]):
+                    row = results[i][0]
+                    v = float(row.argmax()) if row.shape[0] > 1 \
+                        else float(row[0])
+                    fo.write(f"{v:g}\n")
+            self._emit_latency_record("serve")
+            metrics.set_gauge("serve_retraces", sm.retraces)
+            stats = sm.batcher.stats()
+            qps = n_total[0] / max(dur, 1e-9)
+            if metrics.active:
+                metrics.emit(
+                    "serve", model=sm.name, duration_sec=round(dur, 3),
+                    qps=round(qps, 1), dtype=cfg.dtype,
+                    shapes=list(cfg.shapes), clients=cfg.clients,
+                    retraces=sm.retraces,
+                    **stats,
+                    **({"quant_rel_err": metrics.gauges[
+                        "serve_quant_rel_err"]}
+                       if "serve_quant_rel_err" in metrics.gauges else {}))
+            if sm.retraces:
+                mlog.warn(f"serve: {sm.retraces} retrace(s) past warmup "
+                          "— a request shape escaped the declared "
+                          "buckets (serve_shapes)")
+            mlog.result(
+                f"serve: {n_total[0]} requests in {dur:.2f} sec "
+                f"({qps:.1f} req/s), {stats['batches']} dispatches "
+                f"(mean batch {stats['mean_batch']}), retraces "
+                f"{sm.retraces}")
+        finally:
+            sm.close()
+        mlog.notice(f"finished serving, wrote {self.name_pred}")
+
     def run(self, argv: List[str]) -> int:
         if len(argv) < 1:
             mlog.notice("Usage: python -m cxxnet_tpu <config> [key=value ...]")
@@ -988,6 +1156,8 @@ class LearnTask:
                 self.task_predict_raw()
             elif self.task == "extract":
                 self.task_extract()
+            elif self.task == "serve":
+                self.task_serve()
             else:
                 raise ValueError(f"unknown task {self.task!r}")
         finally:

@@ -1449,10 +1449,8 @@ class NetTrainer:
             self.metrics.counter_inc("eval_step_traces")
 
             def body(carry, data):
-                nodes, _, _ = self._forward(params, buffers, data, None, (),
-                                            train=False, rng=None, epoch=0)
-                return carry, {nid: as_mat(nodes[nid]).astype(jnp.float32)
-                               for nid in node_ids}
+                return carry, self.forward_eval(params, buffers, data,
+                                                node_ids)
             _, outs = lax.scan(body, 0, datas)
             return outs
 
@@ -1464,6 +1462,17 @@ class NetTrainer:
         self._eval_many_cache[key] = fn
         return fn
 
+    def forward_eval(self, params, buffers, data, node_ids, extras=()):
+        """Eval-mode forward to flattened float32 node outputs — the
+        shared traced body of the eval steps (:meth:`_get_eval_step`,
+        :meth:`_build_eval_many`) and the serving engine's pinned-bucket
+        predict (serve/engine.py), so batch eval, ``task = pred``, and
+        ``task = serve`` can never drift apart numerically."""
+        nodes, _, _ = self._forward(params, buffers, data, None, extras,
+                                    train=False, rng=None, epoch=0)
+        return {nid: as_mat(nodes[nid]).astype(jnp.float32)
+                for nid in node_ids}
+
     def _get_eval_step(self, node_ids: Tuple[int, ...]):
         self._note_engine_opts()
         if node_ids in self._eval_step_cache:
@@ -1471,10 +1480,8 @@ class NetTrainer:
 
         def estep(params, buffers, data, extras):
             self.metrics.counter_inc("eval_step_traces")
-            nodes, _, _ = self._forward(params, buffers, data, None, extras,
-                                        train=False, rng=None, epoch=0)
-            return {nid: as_mat(nodes[nid]).astype(jnp.float32)
-                    for nid in node_ids}
+            return self.forward_eval(params, buffers, data, node_ids,
+                                     extras)
 
         fn = jax.jit(estep,
                      in_shardings=(self.param_shardings,

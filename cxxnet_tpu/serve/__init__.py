@@ -1,0 +1,131 @@
+"""Inference serving subsystem: ``task = serve`` (doc/serve.md).
+
+The reference ships batch-mode ``task = pred``/``extract`` plus a ctypes
+wrapper — offline inference.  The ROADMAP north star is serving heavy
+traffic, and this package is the missing leg: a donated-buffer,
+pinned-shape predict engine that never retraces in steady state
+(:mod:`.engine`), a dynamic micro-batching front that coalesces
+concurrent client requests (:mod:`.batcher`), and concurrent multi-model
+hosting with shared devices (:mod:`.host`).
+
+Layering (mirrors the train side):
+
+* :class:`~cxxnet_tpu.serve.engine.PredictEngine` — one pre-lowered
+  executable per declared shape bucket (``serve_shapes``), requests
+  padded up to the nearest bucket; ``serve_dtype`` selects the f32 /
+  bf16 / per-channel-int8 weight variants.
+* :class:`~cxxnet_tpu.serve.batcher.MicroBatcher` — bounded request
+  queue + dispatcher thread (the DevicePrefetcher producer-thread
+  discipline run in reverse: many clients feed one device loop).
+* :class:`~cxxnet_tpu.serve.host.ServeModel` /
+  :class:`~cxxnet_tpu.serve.host.ModelHost` — engine+batcher bundles,
+  routed by model name over the process's shared device pool.
+
+Config keys are declared in :data:`SERVE_KEYS` and harvested into
+``main.TASK_KEYS`` so graftlint sees them; :class:`ServeConfig` is the
+parsed form every consumer (CLI task, wrapper, bench) shares.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from ..analysis.schema import K
+
+
+def parse_shapes(val: str) -> List[int]:
+    """Parse a ``serve_shapes`` spec ("1,8,32"); raises ValueError with
+    the same message the lint check reports."""
+    msg = shapes_check(val)
+    if msg is not None:
+        raise ValueError(f"serve_shapes = {val!r}: {msg}")
+    return [int(p) for p in val.split(",") if p.strip()]
+
+
+def shapes_check(val: str) -> Optional[str]:
+    """Lint-time validator for ``serve_shapes`` (KeySpec.check): the
+    buckets must be positive, strictly ascending ints."""
+    try:
+        parts = [int(p) for p in val.split(",") if p.strip()]
+    except ValueError:
+        return "expected comma-separated batch-size buckets, e.g. 1,8,32"
+    if not parts:
+        return "expected at least one batch-size bucket"
+    if any(p <= 0 for p in parts):
+        return "buckets must be positive"
+    if sorted(set(parts)) != parts:
+        return "buckets must be strictly ascending (sorted, no duplicates)"
+    return None
+
+
+#: config keys the serving subsystem consumes (ServeConfig.from_pairs);
+#: merged into main.TASK_KEYS so the declared-key registry and
+#: graftlint's cross-key rules see them (doc/check.md)
+SERVE_KEYS = (
+    K("serve_shapes", "str", check=shapes_check,
+      help="pinned batch-size buckets, ascending (requests pad up to "
+           "the nearest; one pre-lowered executable each)"),
+    K("serve_max_batch", "int", lo=1,
+      help="coalesce at most this many rows per dispatch "
+           "(0/unset = the largest bucket)"),
+    K("serve_max_wait_ms", "float", lo=0.0,
+      help="max time the batcher holds a request open for coalescing"),
+    K("serve_dtype", "enum", choices=("f32", "bf16", "int8"),
+      help="predict variant: f32 reference, bf16 cast, or per-channel "
+           "int8 weights for fullc/conv (doc/serve.md)"),
+    K("serve_clients", "int", lo=1,
+      help="task=serve: concurrent client threads replaying the pred "
+           "iterator as single-row requests"),
+    K("serve_calib", "int", lo=0,
+      help="pairtest the quantized variant against f32 on this many "
+           "request batches at startup (serve_dtype != f32)"),
+    K("serve_queue_depth", "int", lo=1,
+      help="bounded request-queue depth (backpressure past it)"),
+)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Parsed serving configuration, shared by ``task = serve``
+    (main.py), the wrapper's serving path, and ``bench.py --serve``."""
+
+    shapes: Tuple[int, ...] = (1, 8, 32)
+    max_batch: int = 0          # 0 = the largest bucket
+    max_wait_ms: float = 2.0
+    dtype: str = "f32"
+    clients: int = 4
+    calib: int = 0
+    queue_depth: int = 64
+
+    def __post_init__(self):
+        self.shapes = tuple(self.shapes)
+        if not (self.shapes and all(s > 0 for s in self.shapes)
+                and list(self.shapes) == sorted(set(self.shapes))):
+            raise ValueError(
+                f"serve_shapes must be positive ascending, got "
+                f"{self.shapes}")
+        if self.dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"serve_dtype = {self.dtype!r}: expected f32, bf16, or "
+                "int8")
+        if self.max_batch <= 0:
+            self.max_batch = max(self.shapes)
+
+    @classmethod
+    def from_pairs(cls, pairs: Sequence[Tuple[str, str]]) -> "ServeConfig":
+        """Build from ordered config pairs (last occurrence wins, like
+        every ``set_param`` consumer)."""
+        last = {k: v for k, v in pairs if k.startswith("serve_")}
+        kw = {}
+        if "serve_shapes" in last:
+            kw["shapes"] = tuple(parse_shapes(last["serve_shapes"]))
+        for key, field, conv in (("serve_max_batch", "max_batch", int),
+                                 ("serve_max_wait_ms", "max_wait_ms", float),
+                                 ("serve_dtype", "dtype", str),
+                                 ("serve_clients", "clients", int),
+                                 ("serve_calib", "calib", int),
+                                 ("serve_queue_depth", "queue_depth", int)):
+            if key in last:
+                kw[field] = conv(last[key])
+        return cls(**kw)

@@ -1,0 +1,249 @@
+"""Dynamic micro-batching: many client threads -> one device loop.
+
+The inverse of :class:`~cxxnet_tpu.io.device_prefetch.DevicePrefetcher`
+(one producer thread feeding one consumer): here MANY producers — client
+threads calling :meth:`MicroBatcher.submit` — feed a bounded request
+queue, and ONE dispatcher thread drains it, coalescing concurrent
+requests into a single predict call of up to ``serve_max_batch`` rows or
+until ``serve_max_wait_ms`` passes since the batch opened.  The thread
+discipline is the prefetcher's, reused in reverse: a bounded queue for
+backpressure, a poison/latch protocol so a dispatcher failure surfaces
+in every waiting client instead of hanging them, and ``close()`` joins
+the thread (the ThreadBufferIterator hygiene rules).
+
+Coalescing preserves per-row results bit-for-bit at f32: every op in an
+eval-mode forward is row-independent (matmul rows, convolution batch
+elements, eval batch-norm against running stats, per-row softmax), so a
+request served alone in a padded bucket and the same request served
+inside a coalesced batch produce identical bytes — asserted by
+tests/test_serve.py, and the property that makes dynamic batching safe
+to enable by default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class ServeClosed(RuntimeError):
+    """Raised to submitters when the batcher is shut down."""
+
+
+@dataclasses.dataclass
+class _Request:
+    data: np.ndarray
+    event: threading.Event
+    t0: float
+    result: Optional[np.ndarray] = None
+    error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """Bounded request queue + coalescing dispatcher over ``runner``
+    (rows ``(n,) + input_shape`` -> output rows, row-aligned).
+
+    ``submit`` is thread-safe and blocking: it enqueues the request
+    (with backpressure past ``queue_depth``), waits for the dispatcher
+    to serve the coalesced batch, and returns this request's slice of
+    the result.  A dispatch never exceeds ``max_batch`` rows — a
+    request that would overflow the open batch is held back and opens
+    the next one (only a SINGLE request larger than ``max_batch``
+    dispatches alone, and the engine splits it across buckets).  A
+    runner exception fails THE WHOLE batch plus
+    everything queued behind it and latches the batcher dead — clients
+    get the exception, never a hang (the DevicePrefetcher
+    ProducerError contract, fanned out)."""
+
+    def __init__(self, runner: Callable[[np.ndarray], np.ndarray], *,
+                 max_batch: int = 32, max_wait_ms: float = 2.0,
+                 queue_depth: int = 64, metrics=None,
+                 name: str = "serve"):
+        self.runner = runner
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_ms = float(max_wait_ms)
+        self.metrics = metrics
+        self.name = name
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_depth)))
+        self._thread: Optional[threading.Thread] = None
+        self._failed: Optional[BaseException] = None
+        self._closing = False
+        # dispatch accounting for the ``serve`` record / bench report
+        self.n_requests = 0
+        self.n_batches = 0
+        self.rows_served = 0
+        self.batch_hist: Dict[int, int] = {}
+        self.depth_sum = 0
+        self.depth_max = 0
+
+    # ------------------------------------------------------------- client
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"cxxnet-serve-batcher-{self.name}")
+        self._thread.start()
+
+    def submit(self, x: np.ndarray) -> np.ndarray:
+        """One request (``(n,) + input_shape`` rows); returns its output
+        rows once the coalesced batch it rode in completes."""
+        if self._failed is not None:
+            raise self._failed
+        if self._closing:
+            raise ServeClosed(f"batcher {self.name!r} is shut down")
+        assert self._thread is not None, "call start() first"
+        req = _Request(data=np.asarray(x), event=threading.Event(),
+                       t0=time.perf_counter())
+        # bounded put that re-checks the latch: a client must neither
+        # block forever on a dead batcher's full queue nor enqueue
+        # behind the shutdown drain (generation_put's discipline)
+        while True:
+            if self._failed is not None:
+                raise self._failed
+            if self._closing:
+                raise ServeClosed(f"batcher {self.name!r} is shut down")
+            try:
+                self._q.put(req, timeout=0.05)
+                break
+            except queue.Full:
+                continue
+        # the latch can land between the check above and the put: the
+        # dispatcher drains and dies, and our request sits in a queue
+        # nobody reads.  Poll the thread while waiting — if it is gone,
+        # release the queue ourselves (every req gets error + event)
+        while not req.event.wait(0.1):
+            t = self._thread
+            if t is None or not t.is_alive():
+                self._drain(self._failed)
+        if req.error is not None:
+            raise req.error
+        if self.metrics is not None:
+            self.metrics.observe("serve_latency_sec",
+                                 time.perf_counter() - req.t0)
+        return req.result
+
+    # --------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        carry = None        # a coalesce-overflow request held for the
+        while True:         # NEXT batch (dispatches never exceed
+            if carry is not None:                        # max_batch)
+                first, carry = carry, None
+            else:
+                first = self._q.get()
+                if first is None:
+                    return
+            batch = [first]
+            rows = first.data.shape[0]
+            stop = False
+            deadline = time.perf_counter() + self.max_wait_ms / 1e3
+            while rows < self.max_batch:
+                rem = deadline - time.perf_counter()
+                if rem <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=rem)
+                except queue.Empty:
+                    break
+                if r is None:       # shutdown sentinel mid-coalesce:
+                    stop = True     # serve what we have, then exit
+                    break
+                if rows + r.data.shape[0] > self.max_batch:
+                    carry = r       # would overflow: opens the next batch
+                    break
+                batch.append(r)
+                rows += r.data.shape[0]
+            depth = self._q.qsize()
+            self.depth_sum += depth
+            self.depth_max = max(self.depth_max, depth)
+            if self.metrics is not None:
+                self.metrics.set_gauge("serve_queue_depth", depth)
+            if not self._run(batch, rows):
+                if carry is not None:   # latched: the held request must
+                    carry.error = self._failed      # fail too, not hang
+                    carry.event.set()
+                return              # runner failed: latched + drained
+            if stop:
+                return
+
+    def _run(self, batch, rows: int) -> bool:
+        try:
+            if len(batch) == 1:
+                out = self.runner(batch[0].data)
+            else:
+                out = self.runner(
+                    np.concatenate([r.data for r in batch], axis=0))
+            self.n_batches += 1
+            self.n_requests += len(batch)
+            self.rows_served += rows
+            self.batch_hist[rows] = self.batch_hist.get(rows, 0) + 1
+            if self.metrics is not None:
+                self.metrics.observe("serve_batch_rows", rows)
+            off = 0
+            for r in batch:
+                k = r.data.shape[0]
+                r.result = out[off:off + k]
+                off += k
+                r.event.set()
+            return True
+        except BaseException as e:  # noqa: BLE001 — must reach clients
+            self._failed = e
+            for r in batch:
+                r.error = e
+                r.event.set()
+            self._drain(e)
+            return False
+
+    def _drain(self, err: Optional[BaseException]) -> None:
+        """Fail (or, post-shutdown, reject) everything still queued."""
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if r is None:
+                continue
+            r.error = err if err is not None else ServeClosed(
+                f"batcher {self.name!r} shut down before this request "
+                "was served")
+            r.event.set()
+
+    # ------------------------------------------------------------ teardown
+    def close(self) -> None:
+        """Stop accepting requests, serve everything already queued,
+        join the dispatcher, and reject stragglers.  Idempotent."""
+        self._closing = True
+        if self._thread is None:
+            return
+        self._q.put(None)
+        self._thread.join()
+        self._thread = None
+        # requests that raced the sentinel (or arrived after a failure)
+        # must still be released — no client left waiting on an event
+        self._drain(self._failed)
+
+    @property
+    def mean_batch(self) -> float:
+        return self.rows_served / self.n_batches if self.n_batches else 0.0
+
+    @property
+    def mean_depth(self) -> float:
+        return self.depth_sum / self.n_batches if self.n_batches else 0.0
+
+    def stats(self) -> Dict[str, Any]:
+        """Dispatch accounting for the ``serve`` JSONL record."""
+        return {
+            "requests": self.n_requests,
+            "batches": self.n_batches,
+            "rows": self.rows_served,
+            "mean_batch": round(self.mean_batch, 2),
+            "batch_hist": {str(k): v
+                           for k, v in sorted(self.batch_hist.items())},
+            "queue_depth_mean": round(self.mean_depth, 2),
+            "queue_depth_max": self.depth_max,
+        }

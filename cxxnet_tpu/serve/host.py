@@ -1,0 +1,142 @@
+"""Model hosting: engine + batcher bundles, routed by model name.
+
+One process serves N models over the SHARED device pool: every
+:class:`ServeModel` jits against the same JAX devices (and the same
+trainer-level mesh rules), so co-hosted models time-share the chip the
+way co-hosted services time-share a CPU — XLA schedules whichever
+model's executable is dispatched.  Each model keeps its OWN batcher
+thread and its own shape buckets / dtype variant, so a hot model
+coalescing at depth never blocks a cold one's latency.
+
+``ModelHost`` is the routing table (:meth:`ModelHost.predict` by model
+name); :func:`load_serve_model` builds a ServeModel from config pairs +
+a snapshot, the CLI/wrapper-shared path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import ServeConfig
+from .batcher import MicroBatcher
+from .engine import PredictEngine
+from ..monitor import log as mlog
+
+
+class ServeModel:
+    """One served model: a pinned-shape engine fronted by its own
+    micro-batcher.  ``predict`` is the thread-safe client surface."""
+
+    def __init__(self, trainer, cfg: Optional[ServeConfig] = None, *,
+                 metrics=None, name: str = "default"):
+        self.name = name
+        self.cfg = cfg or ServeConfig()
+        self.trainer = trainer
+        self.metrics = metrics if metrics is not None else trainer.metrics
+        self.engine = PredictEngine(trainer, shapes=self.cfg.shapes,
+                                    dtype=self.cfg.dtype,
+                                    metrics=self.metrics)
+        max_batch = min(self.cfg.max_batch, max(self.cfg.shapes))
+        if self.cfg.max_batch > max(self.cfg.shapes):
+            mlog.warn(
+                f"serve[{name}]: serve_max_batch = {self.cfg.max_batch} "
+                f"exceeds the largest bucket ({max(self.cfg.shapes)}); "
+                "coalescing caps at the bucket")
+        self.batcher = MicroBatcher(
+            self.engine.predict, max_batch=max_batch,
+            max_wait_ms=self.cfg.max_wait_ms,
+            queue_depth=self.cfg.queue_depth, metrics=self.metrics,
+            name=name)
+
+    def warmup(self) -> None:
+        """Compile every bucket and start the dispatcher; after this,
+        ``predict`` never traces (``engine.retraces`` stays 0)."""
+        self.engine.warmup()
+        self.batcher.start()
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Raw final-node rows for ``x``, batched with whatever other
+        requests are in flight.  Thread-safe."""
+        return self.batcher.submit(np.asarray(x, np.float32))
+
+    @property
+    def retraces(self) -> int:
+        return self.engine.retraces
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+class ModelHost:
+    """Concurrent multi-model routing over the shared device pool."""
+
+    def __init__(self):
+        self._models: Dict[str, ServeModel] = {}
+
+    def add(self, name: str, trainer, cfg: Optional[ServeConfig] = None,
+            *, metrics=None, warmup: bool = True) -> ServeModel:
+        if name in self._models:
+            raise ValueError(f"model {name!r} already hosted")
+        sm = ServeModel(trainer, cfg, metrics=metrics, name=name)
+        self._models[name] = sm
+        if warmup:
+            sm.warmup()
+        return sm
+
+    def attach(self, sm: ServeModel, *, warmup: bool = True) -> ServeModel:
+        """Host an already-built ServeModel (load_serve_model's output)
+        under its own name."""
+        if sm.name in self._models:
+            raise ValueError(f"model {sm.name!r} already hosted")
+        self._models[sm.name] = sm
+        if warmup:
+            sm.warmup()
+        return sm
+
+    def model(self, name: str) -> ServeModel:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise KeyError(
+                f"no model {name!r} hosted; available: "
+                f"{sorted(self._models)}") from None
+
+    def predict(self, name: str, x: np.ndarray) -> np.ndarray:
+        return self.model(name).predict(x)
+
+    @property
+    def names(self):
+        return sorted(self._models)
+
+    def retraces(self) -> int:
+        return sum(m.retraces for m in self._models.values())
+
+    def close(self) -> None:
+        for m in self._models.values():
+            m.close()
+        self._models.clear()
+
+
+def load_serve_model(pairs: Sequence[Tuple[str, str]], *,
+                     name: str = "default",
+                     warmup: bool = True) -> ServeModel:
+    """Build a ServeModel from ordered config pairs: ``model_in`` names
+    the snapshot (net structure restored from it), ``batch_size``/
+    ``dev``/``dtype``/engine keys configure the trainer, ``serve_*``
+    keys the serving front.  The CLI task and the wrapper's
+    ``ServingHost`` both load through here."""
+    from ..nnet.trainer import NetTrainer
+    last = dict(pairs)
+    model_in = last.get("model_in", "NULL")
+    if model_in == "NULL":
+        raise ValueError("serve: model_in (a snapshot) is required")
+    t = NetTrainer()
+    for k, v in pairs:
+        t.set_param(k, v)
+    t.load_model(model_in)
+    sm = ServeModel(t, ServeConfig.from_pairs(pairs), name=name)
+    if warmup:
+        sm.warmup()
+    return sm

@@ -90,6 +90,7 @@ class Net:
         self._trainer.set_param("dev", dev)
         for k, v in parse_config_string(cfg):
             self._trainer.set_param(k, v)
+        self._serve = None
 
     def set_param(self, name, value) -> None:
         self._trainer.set_param(str(name), str(value))
@@ -121,10 +122,46 @@ class Net:
         else:
             raise TypeError(f"update does not support {type(data)}")
 
+    def enable_serving(self, cfg: str = "") -> None:
+        """Route ``predict`` through the dynamic micro-batching serve
+        path (serve/, doc/serve.md): pinned shape buckets compile once
+        here, then concurrent ``predict`` calls from ANY thread coalesce
+        into batched dispatches and never retrace.  ``cfg`` takes the
+        same ``serve_* = value`` pairs the CLI task does
+        (``"serve_shapes = 1,8\\nserve_dtype = bf16"``).  The legacy
+        single-shot path returns on :meth:`disable_serving` — and stays
+        in use for ``DataIter`` inputs either way (their batches carry
+        padding metadata the serve path deliberately doesn't)."""
+        from ..serve import ServeConfig
+        from ..serve.host import ServeModel
+        if self._serve is not None:
+            raise RuntimeError("serving already enabled")
+        sm = ServeModel(
+            self._trainer, ServeConfig.from_pairs(parse_config_string(cfg)))
+        try:
+            sm.warmup()
+        except BaseException:
+            sm.close()
+            raise
+        self._serve = sm
+
+    def disable_serving(self) -> None:
+        """Shut the batcher down (joins its thread) and restore the
+        legacy single-shot predict."""
+        if self._serve is not None:
+            self._serve.close()
+            self._serve = None
+
     def predict(self, data) -> np.ndarray:
         if isinstance(data, DataIter):
             data.check_valid()
             return self._trainer.predict(data.value)
+        if self._serve is not None:
+            raw = self._serve.predict(
+                _as_batch(np.asarray(data), None).data)
+            if raw.shape[1] > 1:
+                return raw.argmax(axis=1).astype(np.float32)
+            return raw[:, 0]
         return self._trainer.predict(_as_batch(np.asarray(data), None))
 
     def extract(self, data, node_name: str) -> np.ndarray:
@@ -153,6 +190,51 @@ class Net:
             raise ValueError("tag must be bias or wmat")
         self._trainer.set_weight(np.asarray(weight, np.float32),
                                  layer_name, tag)
+
+
+class ServingHost:
+    """Concurrent multi-model serving from Python (serve/host.py over
+    config strings): load N snapshots, route by model name, share the
+    process's device pool.  Each model gets its own micro-batcher and
+    shape buckets, so ``predict`` is thread-safe per model AND across
+    models.
+
+        host = ServingHost()
+        host.add_model("mnist", "model_in = m/0010.model\\n"
+                                "batch_size = 100\\nserve_shapes = 1,8")
+        host.predict("mnist", rows)   # from any thread
+        host.close()
+    """
+
+    def __init__(self, dev: str = "tpu"):
+        from ..serve.host import ModelHost
+        self._dev = dev
+        self._host = ModelHost()
+
+    def add_model(self, name: str, cfg: str) -> None:
+        """Load one snapshot behind its own engine+batcher.  ``cfg`` is
+        the usual config-string surface and must carry ``model_in``
+        (the snapshot) and ``batch_size``; ``serve_*`` keys configure
+        this model's buckets/dtype/batching."""
+        from ..serve.host import load_serve_model
+        pairs = [("dev", self._dev)] + parse_config_string(cfg)
+        self._host.attach(load_serve_model(pairs, name=name, warmup=False))
+
+    @property
+    def models(self):
+        return self._host.names
+
+    def predict(self, name: str, data: np.ndarray) -> np.ndarray:
+        """Raw output rows of model ``name`` for ``(n, c, h, w)`` data."""
+        return self._host.predict(name,
+                                  _as_batch(np.asarray(data), None).data)
+
+    def retraces(self) -> int:
+        """Total traces past warmup across hosted models (0 = healthy)."""
+        return self._host.retraces()
+
+    def close(self) -> None:
+        self._host.close()
 
 
 def train(cfg: str, data, num_round: int, param, eval_data=None,

@@ -93,6 +93,32 @@ def test_bench_opt_ab_mode():
     assert opts.fused_update == "0" and opts.pallas_ln == "1"
 
 
+def test_bench_serve_mode():
+    """--serve --tiny payload: one offered-QPS point over the serving
+    subsystem with latency percentiles, the coalescer's batch-size
+    histogram, and the zero-retrace-after-warmup guarantee."""
+    import bench
+    payload = bench.bench_serve(
+        ["--tiny", "dev=cpu", "offered_qps=200", "duration=0.4",
+         "clients=4"])
+    assert payload["metric"] == "serve_p95_ms"
+    assert payload["retraces"] == 0
+    assert payload["warmup_sec"] > 0
+    assert payload["shapes"] == [1, 8]
+    [pt] = payload["points"]
+    assert pt["offered_qps"] == 200.0
+    assert pt["requests"] > 0 and pt["achieved_qps"] > 0
+    assert 0 < pt["p50_ms"] <= pt["p95_ms"] <= pt["p99_ms"]
+    assert pt["mean_batch"] >= 1.0
+    assert sum(int(k) * v for k, v in pt["batch_hist"].items()) \
+        == pt["requests"]
+    assert payload["value"] == pt["p95_ms"]
+    # thread hygiene: the bench closed its batcher
+    import threading
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxxnet-serve")]
+
+
 def test_comm_axis_shares_mapping():
     """Per-axis attribution table: data reductions vs model gathers."""
     import bench

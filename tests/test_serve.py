@@ -1,0 +1,641 @@
+"""Serving subsystem (serve/, task=serve — ISSUE 8, doc/serve.md).
+
+Covers the contracts serving stands on: the micro-batcher coalesces
+concurrent requests and NEVER hangs a client (timeout flush, exception
+fan-out, shutdown hygiene — the ThreadBufferIterator discipline run in
+reverse); the pinned-shape engine pads requests up to declared buckets
+and never retraces after warmup; coalesced-vs-single predict is bitwise
+identical at f32 (the property that makes dynamic batching safe to
+enable); bf16/int8 quantized variants stay inside their declared
+SERVE_TOL envelopes; multi-model hosting routes by name; and the CLI
+task emits the latency/serve records the observatory reads.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.monitor.metrics import MetricsRegistry
+from cxxnet_tpu.serve import ServeConfig, parse_shapes, shapes_check
+from cxxnet_tpu.serve.batcher import MicroBatcher, ServeClosed
+from cxxnet_tpu.serve.engine import (SERVE_TOL, PredictEngine,
+                                     quantize_per_channel)
+from cxxnet_tpu.serve.host import ModelHost, ServeModel, load_serve_model
+
+
+def _serve_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("cxxnet-serve")]
+
+
+# ------------------------------------------------------------ batcher units
+# Fake runners keep these pure thread-protocol tests: no jax, no model.
+
+def _echo_runner(calls):
+    """Row-aligned identity that records each dispatched batch size."""
+    def run(x):
+        calls.append(x.shape[0])
+        time.sleep(0.01)  # wide-enough dispatch for coalescing to bite
+        return x * 2.0
+    return run
+
+
+def test_batcher_coalesces_concurrent_requests():
+    calls = []
+    b = MicroBatcher(_echo_runner(calls), max_batch=16, max_wait_ms=50.0)
+    b.start()
+    try:
+        outs = [None] * 8
+
+        def client(i):
+            outs[i] = b.submit(np.full((1, 4), float(i), np.float32))
+
+        ths = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        # every client got ITS rows back (row alignment through the
+        # coalesced batch), and the 8 requests rode in < 8 dispatches
+        for i in range(8):
+            np.testing.assert_array_equal(outs[i],
+                                          np.full((1, 4), 2.0 * i))
+        assert b.n_requests == 8 and b.rows_served == 8
+        assert b.n_batches < 8, calls
+        assert sum(calls) == 8
+    finally:
+        b.close()
+
+
+def test_batcher_timeout_flushes_partial_batch():
+    """A lone request must be served after ~max_wait_ms, not held until
+    max_batch fills."""
+    calls = []
+    b = MicroBatcher(_echo_runner(calls), max_batch=64, max_wait_ms=5.0)
+    b.start()
+    try:
+        t0 = time.perf_counter()
+        out = b.submit(np.ones((1, 3), np.float32))
+        took = time.perf_counter() - t0
+        np.testing.assert_array_equal(out, 2 * np.ones((1, 3)))
+        assert calls == [1]
+        assert took < 2.0, f"timeout flush took {took:.3f}s"
+    finally:
+        b.close()
+
+
+def test_batcher_respects_max_batch():
+    calls = []
+    b = MicroBatcher(_echo_runner(calls), max_batch=4, max_wait_ms=100.0)
+    b.start()
+    try:
+        ths = [threading.Thread(
+            target=lambda: b.submit(np.zeros((1, 2), np.float32)))
+            for _ in range(12)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert max(calls) <= 4
+        assert sum(calls) == 12
+    finally:
+        b.close()
+
+
+def test_batcher_multirow_requests_split_correctly():
+    calls = []
+    b = MicroBatcher(_echo_runner(calls), max_batch=32, max_wait_ms=30.0)
+    b.start()
+    try:
+        outs = {}
+
+        def client(i, n):
+            outs[i] = b.submit(np.full((n, 2), float(i), np.float32))
+
+        ths = [threading.Thread(target=client, args=(i, n))
+               for i, n in enumerate((1, 3, 2))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        for i, n in enumerate((1, 3, 2)):
+            assert outs[i].shape == (n, 2)
+            np.testing.assert_array_equal(outs[i], np.full((n, 2), 2.0 * i))
+    finally:
+        b.close()
+
+
+def test_batcher_runner_exception_reaches_all_clients():
+    """A runner failure must fan out to every rider of the batch AND
+    everything queued behind it, then latch the batcher dead — the
+    DevicePrefetcher ProducerError contract: clients get the exception,
+    never a hang."""
+    def boom(x):
+        time.sleep(0.005)
+        raise RuntimeError("device on fire")
+
+    b = MicroBatcher(boom, max_batch=4, max_wait_ms=5.0, queue_depth=64)
+    b.start()
+    errs = []
+
+    def client():
+        try:
+            b.submit(np.zeros((1, 2), np.float32))
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    ths = [threading.Thread(target=client) for _ in range(6)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in ths), "a client hung"
+    assert errs == ["device on fire"] * 6
+    # latched: later submits fail fast with the same error
+    with pytest.raises(RuntimeError, match="device on fire"):
+        b.submit(np.zeros((1, 2), np.float32))
+    b.close()
+    assert not _serve_threads()
+
+
+def test_batcher_close_thread_hygiene():
+    b = MicroBatcher(_echo_runner([]), max_batch=4, max_wait_ms=1.0,
+                     name="hygiene")
+    b.start()
+    assert any(t.name == "cxxnet-serve-batcher-hygiene"
+               for t in threading.enumerate())
+    b.submit(np.zeros((1, 2), np.float32))
+    b.close()
+    assert not any(t.name == "cxxnet-serve-batcher-hygiene"
+                   for t in threading.enumerate())
+    with pytest.raises(ServeClosed):
+        b.submit(np.zeros((1, 2), np.float32))
+    b.close()  # idempotent
+
+
+def test_batcher_stats_accounting():
+    b = MicroBatcher(_echo_runner([]), max_batch=8, max_wait_ms=1.0)
+    b.start()
+    try:
+        for _ in range(3):
+            b.submit(np.zeros((2, 2), np.float32))
+        s = b.stats()
+        assert s["requests"] == 3 and s["rows"] == 6
+        assert sum(int(k) * v for k, v in s["batch_hist"].items()) == 6
+        assert s["queue_depth_max"] >= 0
+    finally:
+        b.close()
+
+
+def test_batcher_latency_histogram():
+    reg = MetricsRegistry()
+    b = MicroBatcher(_echo_runner([]), max_batch=4, max_wait_ms=1.0,
+                     metrics=reg)
+    b.start()
+    try:
+        for _ in range(4):
+            b.submit(np.zeros((1, 2), np.float32))
+    finally:
+        b.close()
+    h = reg.histograms["serve_latency_sec"]
+    assert h.count == 4
+    s = h.summary()
+    assert 0 < s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+    assert "serve_queue_depth" in reg.gauges
+
+
+# ----------------------------------------------------------- engine + model
+
+MLP_NET = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 24
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 5
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,16
+eta = 0.1
+"""
+
+IN_SHAPE = (1, 1, 16)
+
+
+def _trainer(net=MLP_NET, batch=8):
+    from __graft_entry__ import _make_trainer
+    return _make_trainer(net, batch, "cpu")
+
+
+@pytest.fixture(scope="module")
+def mlp_trainer():
+    return _trainer()
+
+
+@pytest.fixture(scope="module")
+def mlp_engine(mlp_trainer):
+    eng = PredictEngine(mlp_trainer, shapes=(1, 4, 8), dtype="f32")
+    eng.warmup()
+    return eng
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, *IN_SHAPE) \
+        .astype(np.float32)
+
+
+def _databatch(x):
+    return DataBatch(data=x,
+                     label=np.zeros((x.shape[0], 1), np.float32),
+                     index=np.arange(x.shape[0], dtype=np.uint32))
+
+
+def test_bucket_for_mapping(mlp_engine):
+    assert [mlp_engine.bucket_for(n) for n in (1, 2, 4, 5, 8, 99)] \
+        == [1, 4, 4, 8, 8, 8]
+
+
+def test_engine_pads_and_unpads(mlp_engine):
+    """n=3 pads up to the 4-bucket but returns exactly 3 rows; an
+    oversize request splits across max-bucket dispatches."""
+    out = mlp_engine.predict(_rows(3))
+    assert out.shape == (3, 5)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    big = mlp_engine.predict(_rows(19))
+    assert big.shape == (19, 5)
+    assert mlp_engine.retraces == 0
+
+
+def test_engine_zero_retrace_after_warmup(mlp_engine, mlp_trainer):
+    before = mlp_trainer.metrics.counters.get("serve_step_traces", 0)
+    for n in (1, 2, 3, 4, 5, 8, 11, 20):
+        mlp_engine.predict(_rows(n, seed=n))
+    assert mlp_trainer.metrics.counters["serve_step_traces"] == before
+    assert mlp_engine.retraces == 0
+
+
+def test_engine_batched_vs_single_bitwise_f32(mlp_engine):
+    """THE dynamic-batching safety property: a row served alone (padded
+    1-bucket) and the same row inside a full batch produce identical
+    bytes — eval-mode forward is row-independent."""
+    x = _rows(8, seed=3)
+    batched = mlp_engine.predict(x)
+    for i in range(8):
+        single = mlp_engine.predict(x[i:i + 1])
+        np.testing.assert_array_equal(single[0], batched[i])
+
+
+def test_engine_input_shape_rejected(mlp_engine):
+    with pytest.raises(ValueError, match="predict"):
+        mlp_engine.predict(np.zeros((2, 1, 1, 7), np.float32))
+
+
+def test_engine_bad_dtype_rejected(mlp_trainer):
+    with pytest.raises(ValueError, match="serve_dtype"):
+        PredictEngine(mlp_trainer, dtype="fp8")
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_quantized_variants_inside_envelope(mlp_trainer, dtype):
+    eng = PredictEngine(mlp_trainer, shapes=(4,), dtype=dtype)
+    eng.warmup()
+    err = eng.pairtest(_rows(4, seed=7))
+    assert err <= SERVE_TOL[dtype], \
+        f"{dtype}: rel err {err} > envelope {SERVE_TOL[dtype]}"
+    assert err > 0.0  # the variant really does transform the weights
+    assert eng.retraces == 0
+
+
+def test_quantize_per_channel_roundtrip():
+    w = np.random.RandomState(0).randn(6, 9).astype(np.float32)
+    w[2] = 0.0  # dead channel: scale 0, no div-by-zero
+    q, s = quantize_per_channel(w)
+    assert q.dtype == np.int8 and np.abs(q).max() <= 127
+    assert s.shape == (6, 1)
+    assert s[2] == 0.0 and not q[2].any()
+    # per-channel absmax quantization: error bounded by scale/2 per entry
+    np.testing.assert_allclose(q * s, w, atol=float(s.max()) / 2 + 1e-7)
+    # conv-layout weights keep dim 0 as the channel
+    wc = np.random.RandomState(1).randn(4, 2, 3, 3).astype(np.float32)
+    qc, sc = quantize_per_channel(wc)
+    assert sc.shape == (4, 1, 1, 1)
+    np.testing.assert_allclose(qc * sc, wc, atol=float(sc.max()) / 2 + 1e-7)
+
+
+def test_serve_model_concurrent_parity(mlp_trainer):
+    """Concurrent clients through the full ServeModel stack (batcher ->
+    engine): every client's answer equals the engine's single-shot
+    prediction for its row, zero retraces, clean shutdown."""
+    sm = ServeModel(mlp_trainer, ServeConfig(shapes=(1, 4, 8),
+                                             max_wait_ms=5.0),
+                    name="parity")
+    sm.warmup()
+    try:
+        x = _rows(16, seed=11)
+        want = sm.engine.predict(x)
+        got = [None] * 16
+
+        def client(i):
+            got[i] = sm.predict(x[i:i + 1])
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(16)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=30.0)
+        assert not any(t.is_alive() for t in ths)
+        for i in range(16):
+            np.testing.assert_array_equal(got[i][0], want[i])
+        assert sm.retraces == 0
+        assert sm.batcher.n_requests == 16
+    finally:
+        sm.close()
+    assert not any(t.name == "cxxnet-serve-batcher-parity"
+                   for t in threading.enumerate())
+
+
+# ------------------------------------------------------------- multi-model
+
+def test_model_host_routes_by_name():
+    t_a = _trainer()
+    t_b = _trainer(MLP_NET.replace("nhidden = 5", "nhidden = 3"))
+    host = ModelHost()
+    try:
+        host.add("alpha", t_a, ServeConfig(shapes=(1, 4)))
+        host.add("beta", t_b, ServeConfig(shapes=(1, 4)))
+        assert host.names == ["alpha", "beta"]
+        x = _rows(2, seed=5)
+        # routing is observable: the two nets have different widths
+        assert host.predict("alpha", x).shape == (2, 5)
+        assert host.predict("beta", x).shape == (2, 3)
+        np.testing.assert_array_equal(host.predict("alpha", x),
+                                      host.model("alpha").engine.predict(x))
+        with pytest.raises(KeyError, match="gamma"):
+            host.predict("gamma", x)
+        with pytest.raises(ValueError, match="already hosted"):
+            host.add("alpha", t_a)
+        assert host.retraces() == 0
+    finally:
+        host.close()
+    assert not _serve_threads()
+    assert host.names == []
+
+
+def test_load_serve_model_from_snapshot(tmp_path):
+    """The CLI/wrapper-shared loader: net structure + weights restored
+    from the snapshot, serve_* pairs configure the front."""
+    t = _trainer()
+    snap = str(tmp_path / "0001.model")
+    t.save_model(snap)
+    sm = load_serve_model(
+        [("dev", "cpu"), ("batch_size", "8"), ("model_in", snap),
+         ("serve_shapes", "1,4"), ("serve_dtype", "f32")], name="reloaded")
+    try:
+        x = _rows(4, seed=2)
+        np.testing.assert_array_equal(sm.predict(x),
+                                      t.predict_raw(_databatch(x)))
+    finally:
+        sm.close()
+    with pytest.raises(ValueError, match="model_in"):
+        load_serve_model([("dev", "cpu"), ("batch_size", "8")])
+
+
+# ------------------------------------------------------------- ServeConfig
+
+def test_serve_config_defaults_and_pairs():
+    cfg = ServeConfig()
+    assert cfg.shapes == (1, 8, 32)
+    assert cfg.max_batch == 32  # 0 -> the largest bucket
+    cfg = ServeConfig.from_pairs([
+        ("serve_shapes", "1,8"), ("serve_dtype", "bf16"),
+        ("serve_max_wait_ms", "3.5"), ("serve_clients", "2"),
+        ("serve_shapes", "2,16"),  # last occurrence wins
+        ("unrelated", "x")])
+    assert cfg.shapes == (2, 16) and cfg.dtype == "bf16"
+    assert cfg.max_wait_ms == 3.5 and cfg.max_batch == 16
+
+
+def test_parse_shapes_rejects_malformed():
+    assert parse_shapes("1,8,32") == [1, 8, 32]
+    for bad in ("8,1", "1,1,8", "0,8", "-1", "a,b", ""):
+        assert shapes_check(bad) is not None, bad
+        with pytest.raises(ValueError, match="serve_shapes"):
+            parse_shapes(bad)
+    with pytest.raises(ValueError, match="serve_dtype"):
+        ServeConfig(dtype="fp8")
+
+
+# -------------------------------------------------------------- lint rules
+
+def _lint(cfg_text):
+    from cxxnet_tpu.analysis import conflint
+    from cxxnet_tpu.utils.config import parse_config_string
+    return conflint.lint_pairs(parse_config_string(cfg_text))
+
+
+def _findings_for(findings, key, severity=None):
+    return [f for f in findings if f.key == key
+            and (severity is None or f.severity == severity)]
+
+
+def test_lint_serve_keys_warn_off_task():
+    fs = _lint("task = train\nserve_shapes = 1,8\n")
+    assert _findings_for(fs, "serve_shapes", "warn")
+
+
+def test_lint_int8_without_calib_warns():
+    base = ("task = serve\nmodel_in = m.model\npred = out.txt\n"
+            "iter = mnist\niter = end\nbatch_size = 8\n")
+    fs = _lint(base + "serve_dtype = int8\n")
+    assert _findings_for(fs, "serve_dtype", "warn")
+    fs = _lint(base + "serve_dtype = int8\nserve_calib = 2\n")
+    assert not _findings_for(fs, "serve_dtype", "warn")
+
+
+def test_lint_max_batch_above_bucket_warns():
+    fs = _lint("task = serve\nmodel_in = m.model\npred = out.txt\n"
+               "iter = mnist\niter = end\nbatch_size = 8\n"
+               "serve_shapes = 1,8\nserve_max_batch = 64\n")
+    assert _findings_for(fs, "serve_max_batch", "warn")
+
+
+def test_lint_serve_requires_snapshot_and_pred():
+    fs = _lint("task = serve\n")
+    assert _findings_for(fs, "model_in", "error")
+    assert _findings_for(fs, "pred", "error")
+
+
+def test_lint_malformed_shapes_is_error():
+    fs = _lint("task = serve\nmodel_in = m.model\npred = out.txt\n"
+               "iter = mnist\niter = end\nserve_shapes = 8,1\n")
+    assert _findings_for(fs, "serve_shapes", "error")
+
+
+# ------------------------------------------------------------ wrapper path
+
+def test_wrapper_enable_serving_parity():
+    from cxxnet_tpu.wrapper import Net
+    net = Net(dev="cpu", cfg=MLP_NET + "batch_size = 8\n")
+    net.init_model()
+    x = _rows(4, seed=9)
+    legacy = net.predict(x)
+    net.enable_serving("serve_shapes = 1,4\nserve_max_wait_ms = 1.0")
+    try:
+        with pytest.raises(RuntimeError, match="already enabled"):
+            net.enable_serving()
+        served = net.predict(x)
+        np.testing.assert_array_equal(served, legacy)
+    finally:
+        net.disable_serving()
+    assert not _serve_threads()
+    np.testing.assert_array_equal(net.predict(x), legacy)
+
+
+def test_wrapper_serving_host_multi_model(tmp_path):
+    from cxxnet_tpu.wrapper.api import ServingHost
+    t = _trainer()
+    snap = str(tmp_path / "m.model")
+    t.save_model(snap)
+    host = ServingHost(dev="cpu")
+    try:
+        host.add_model("one", f"model_in = {snap}\nbatch_size = 8\n"
+                              "serve_shapes = 1,4")
+        host.add_model("two", f"model_in = {snap}\nbatch_size = 8\n"
+                              "serve_shapes = 1,4\nserve_dtype = bf16")
+        assert host.models == ["one", "two"]
+        x = _rows(2, seed=4)
+        np.testing.assert_array_equal(host.predict("one", x),
+                                      t.predict_raw(_databatch(x)))
+        # the bf16 co-hosted variant answers too, inside its envelope
+        rel = np.abs(host.predict("two", x) - host.predict("one", x))
+        assert float(rel.max()) <= SERVE_TOL["bf16"] * \
+            (float(np.abs(host.predict("one", x)).max()) + 1e-6)
+        assert host.retraces() == 0
+    finally:
+        host.close()
+    assert not _serve_threads()
+
+
+# ------------------------------------------------------------- CLI e2e
+
+@pytest.fixture
+def trained_model(tmp_path):
+    from cxxnet_tpu.main import LearnTask
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import make_synth_mnist as sm
+    rnd = np.random.RandomState(0)
+    labels = rnd.randint(0, 4, 96)
+    imgs = np.stack([
+        np.clip(sm.class_pattern(l, 12, 12) * 255
+                + rnd.rand(12, 12) * 32, 0, 255) for l in labels])
+    sm.write_idx_images(str(tmp_path / "img.gz"), imgs)
+    sm.write_idx_labels(str(tmp_path / "lbl.gz"), labels)
+    net = MLP_NET.replace("input_shape = 1,1,16", "input_shape = 1,1,144")
+    conf = tmp_path / "train.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{net}
+batch_size = 16
+num_round = 2
+model_dir = {tmp_path}/models
+save_model = 2
+silent = 1
+""")
+    assert LearnTask().run([str(conf)]) == 0
+    return tmp_path, net, str(tmp_path / "models" / "0002.model")
+
+
+def _serve_conf(tmp_path, net, model, extra=""):
+    conf = tmp_path / "serve.conf"
+    conf.write_text(f"""
+dev = cpu
+task = serve
+model_in = {model}
+pred = {tmp_path}/serve_out.txt
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+{net}
+batch_size = 16
+serve_shapes = 1,8
+serve_clients = 4
+silent = 1
+metrics_sink = jsonl:{tmp_path}/serve_metrics.jsonl
+{extra}
+""")
+    return conf
+
+
+def test_cli_serve_end_to_end(trained_model):
+    """task=serve under concurrent clients: output identical to
+    task=pred, zero retraces, one latency record with percentiles plus
+    the serve record with queue-depth gauges — the ISSUE 8 acceptance
+    run."""
+    import json
+
+    from cxxnet_tpu.main import LearnTask
+    tmp_path, net, model = trained_model
+    assert LearnTask().run([str(_serve_conf(tmp_path, net, model))]) == 0
+    out = np.loadtxt(tmp_path / "serve_out.txt")
+    assert out.shape == (96,)
+
+    pred_conf = tmp_path / "pred.conf"
+    pred_conf.write_text(
+        _serve_conf(tmp_path, net, model).read_text()
+        .replace("task = serve", "task = pred")
+        .replace("pred = " + str(tmp_path) + "/serve_out.txt",
+                 "pred = " + str(tmp_path) + "/cls.txt")
+        .replace("metrics_sink", "# metrics_sink"))
+    assert LearnTask().run([str(pred_conf)]) == 0
+    np.testing.assert_array_equal(out, np.loadtxt(tmp_path / "cls.txt"))
+
+    recs = [json.loads(l)
+            for l in open(tmp_path / "serve_metrics.jsonl")]
+    lat = [r for r in recs if r["kind"] == "latency"]
+    srv = [r for r in recs if r["kind"] == "serve"]
+    assert len(lat) == 1 and len(srv) == 1
+    assert lat[0]["op"] == "serve" and lat[0]["count"] == 96
+    assert 0 < lat[0]["p50"] <= lat[0]["p95"] <= lat[0]["p99"]
+    assert srv[0]["retraces"] == 0
+    assert srv[0]["requests"] == 96
+    assert srv[0]["rows"] == 96
+    assert srv[0]["queue_depth_max"] >= srv[0]["queue_depth_mean"] >= 0
+    assert sum(int(k) * v for k, v in srv[0]["batch_hist"].items()) == 96
+    assert not _serve_threads()
+
+
+def test_cli_serve_int8_with_calibration(trained_model):
+    """serve_dtype=int8 + serve_calib: the startup pairtest measures the
+    quantization error on real request batches and lands it in the
+    serve record, inside the declared envelope."""
+    import json
+
+    from cxxnet_tpu.main import LearnTask
+    tmp_path, net, model = trained_model
+    conf = _serve_conf(tmp_path, net, model,
+                       extra="serve_dtype = int8\nserve_calib = 2\n")
+    assert LearnTask().run([str(conf)]) == 0
+    recs = [json.loads(l)
+            for l in open(tmp_path / "serve_metrics.jsonl")]
+    srv = [r for r in recs if r["kind"] == "serve"][-1]
+    assert srv["dtype"] == "int8"
+    assert 0 < srv["quant_rel_err"] <= SERVE_TOL["int8"]
+    assert srv["retraces"] == 0
+    # int8 argmax predictions still agree with f32 on a trained net
+    out = np.loadtxt(tmp_path / "serve_out.txt")
+    assert out.shape == (96,)

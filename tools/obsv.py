@@ -119,6 +119,13 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
             "rows": (lp.get("rows") or [])[:top],
             "dropped_rows": max(len(lp.get("rows") or []) - top, 0),
         }
+    if by.get("serve"):
+        rep["serving"] = [
+            {k: r.get(k) for k in
+             ("model", "requests", "duration_sec", "qps", "offered_qps",
+              "batches", "mean_batch", "batch_hist", "queue_depth_mean",
+              "queue_depth_max", "dtype", "shapes", "clients", "retraces",
+              "quant_rel_err") if k in r} for r in by["serve"]]
     if by.get("latency"):
         rep["latency"] = [
             {k: r.get(k) for k in
@@ -216,6 +223,34 @@ def render(rep: dict) -> str:
         if lp.get("dropped_rows"):
             out.append(f"... {lp['dropped_rows']} more rows "
                        "(--top to widen)")
+    srv = rep.get("serving")
+    if srv:
+        out.append("")
+        n_retr = sum(r.get("retraces") or 0 for r in srv)
+        out.append(
+            f"serving: {len(srv)} run(s); retraces past warmup: {n_retr}"
+            + ("" if not n_retr else "  <-- a request shape escaped "
+               "the declared buckets"))
+        out.append(_table(
+            ["model", "dtype", "qps", "requests", "batches", "mean_b",
+             "q_mean", "q_max"],
+            [[str(r.get("model", "?")), str(r.get("dtype", "?")),
+              _fmt(r.get("qps"), 1), _fmt(r.get("requests")),
+              _fmt(r.get("batches")), _fmt(r.get("mean_batch")),
+              _fmt(r.get("queue_depth_mean")),
+              _fmt(r.get("queue_depth_max"))] for r in srv]))
+        hist = srv[-1].get("batch_hist") or {}
+        if hist:
+            total = sum(hist.values()) or 1
+            out.append("batch sizes (last run): " + "  ".join(
+                f"{k}x{v} ({v / total:.0%})"
+                for k, v in sorted(hist.items(), key=lambda kv:
+                                   int(kv[0]))))
+        errs = [r["quant_rel_err"] for r in srv
+                if r.get("quant_rel_err") is not None]
+        if errs:
+            out.append(f"quantization pairtest vs f32: max rel err "
+                       f"{_fmt(max(errs), 4)}")
     lat = rep.get("latency")
     if lat:
         out.append("")
