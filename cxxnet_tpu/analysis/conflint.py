@@ -363,6 +363,64 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
             add(Finding("error", "extract_node_name",
                         "task = extract requires extract_node_name"))
     _serve_rules(last, task, add)
+    _ckpt_rules(last, task, monitor, add)
+
+
+def _ckpt_rules(last: Dict[str, str], task: str, monitor: int, add) -> None:
+    """Cross-key rules for the checkpoint / rollback subsystem
+    (doc/checkpoint.md).  ``continue = 1`` skipping partial/corrupt
+    snapshots is runtime behavior documented in doc/checkpoint.md, not a
+    lint rule — there is nothing to check statically."""
+    rollback = _as_int(last, "rollback", 0)
+    ckpt_keep = _as_int(last, "ckpt_keep", 3)
+    if task not in ("train", "finetune"):
+        for k in ("ckpt_async", "ckpt_keep", "rollback", "save_opt",
+                  "ckpt_iter_state"):
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect without task = "
+                            "train/finetune (checkpoints are written by "
+                            "the train loop)"))
+                break
+        return
+    if rollback > 0:
+        if not monitor or last.get("monitor_nan", "warn") != "fatal":
+            add(Finding("warn", "rollback",
+                        "rollback only triggers on TrainingDiverged, "
+                        "which is raised by monitor_nan = fatal under "
+                        "monitor = 1; with the current settings the "
+                        "divergence is never raised and rollback never "
+                        "runs"))
+        if "model_dir" not in last:
+            add(Finding("warn", "rollback",
+                        "rollback restores snapshots from model_dir; "
+                        "set it explicitly (the default './' litters the "
+                        "working directory and is rarely intended)"))
+        if _as_int(last, "save_model", 1) == 0:
+            add(Finding("error", "rollback",
+                        "rollback needs snapshots to restore, but "
+                        "save_model = 0 disables them"))
+        if _as_int(last, "save_opt", 1) == 0:
+            add(Finding("info", "save_opt",
+                        "save_opt = 0 with rollback: the restored run "
+                        "restarts optimizer moments from zero, so the "
+                        "retried window is not the checkpointed "
+                        "trajectory"))
+        if ckpt_keep < 2:
+            add(Finding("warn", "ckpt_keep",
+                        "ckpt_keep = 1 with rollback: if the newest "
+                        "snapshot carries the divergence (or a kill "
+                        "corrupts it) there is no older one to fall "
+                        "back to; keep at least 2"))
+    if "ckpt_keep" in last and _as_int(last, "ckpt_async", 0) == 0:
+        add(Finding("warn", "ckpt_keep",
+                    "ckpt_keep prunes NNNN.ckpt snapshot dirs, which "
+                    "only ckpt_async = 1 writes; legacy .model files "
+                    "are never pruned"))
+    if "ckpt_iter_state" in last and _as_int(last, "save_model", 1) == 0:
+        add(Finding("warn", "ckpt_iter_state",
+                    "ckpt_iter_state has no effect with save_model = 0 "
+                    "(no snapshots carry it)"))
 
 
 def _serve_rules(last: Dict[str, str], task: str, add) -> None:

@@ -1,0 +1,243 @@
+"""Fault-tolerant checkpoints: atomic snapshot dirs + exact-resume manifest.
+
+The reference's production story is a parameter server holding model
+state server-side so workers can come and go (mshadow-ps
+``ISharedModel``); the TPU-native equivalent is preemption-safe
+training.  Before this package a checkpoint was one non-atomic
+``np.savez`` (a kill mid-write left a corrupt *newest* snapshot that
+``continue = 1`` then loaded) and resume was not trajectory-exact (rng
+restarted from the seed, optimizer state was opt-in, the iterator
+restarted cold).
+
+A **snapshot** here is a directory ``<model_dir>/NNNN.ckpt/`` written
+with a manifest-last protocol:
+
+1. each shard (``params`` / ``buffers`` / ``opt`` / ``acc``) is written
+   to ``<shard>.npz.tmp`` and ``os.replace``d to ``<shard>.npz``;
+2. ``MANIFEST.json`` is written to a temp name, fsynced, and
+   ``os.replace``d into place **last**.
+
+The manifest is the commit marker: a snapshot without one — or whose
+shard files fail their recorded size/crc32 — is partial/corrupt and is
+*skipped* by ``continue = 1`` (the previous snapshot wins).  A kill at
+any byte of the write sequence therefore never loses the previous good
+snapshot and never yields a loadable half-written one.
+
+The manifest also carries everything exact resume needs beyond the
+arrays: epoch/round counters, the live rng stream (``sample_counter`` +
+the raw PRNG key, so a rolled-back-and-reseeded run resumes *its own*
+stream, not the seed's), the train-iterator chain state
+(``IIterator.state()``), and the sentinel EWMA state.  Arrays are
+stored as full host (logical) arrays, so a snapshot taken on one mesh
+restores onto any device count — ``load_model`` reshards via the
+current trainer's NamedShardings.
+
+See :mod:`.writer` for the async off-thread writer and doc/checkpoint.md
+for the format and knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis.schema import K
+from ..utils.serializer import atomic_write
+
+FORMAT_VERSION = 1
+MANIFEST = "MANIFEST.json"
+
+#: checkpoint / rollback config keys the task driver consumes
+#: (main.LearnTask.set_param); declared here next to their subsystem and
+#: appended to TASK_KEYS so the lint registry harvests them.
+CKPT_KEYS = (
+    K("ckpt_async", "int", lo=0, hi=1,
+      help="write snapshots off the training thread (atomic .ckpt dirs)"),
+    K("ckpt_keep", "int", lo=1,
+      help="retention: keep the newest N .ckpt snapshots"),
+    K("rollback", "int", lo=0,
+      help="on TrainingDiverged: restore the last good snapshot, reseed "
+           "the rng stream, retry up to N times"),
+    K("save_opt", "int", lo=0, hi=1,
+      help="include optimizer state in snapshots (default 1: exact "
+           "resume)"),
+    K("ckpt_iter_state", "int", lo=0, hi=1,
+      help="carry the train-iterator chain state in snapshots (default "
+           "1: cross-round iterator rng/cache state resumes exactly)"),
+)
+
+
+def snapshot_path(model_dir: str, counter: int) -> str:
+    return os.path.join(model_dir, f"{counter:04d}.ckpt")
+
+
+def _crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+# one copy of the tmp + fsync + os.replace durability protocol, shared
+# with the legacy single-file save (utils/serializer.py)
+_replace_write = atomic_write
+
+
+def write_snapshot(path: str, shards: Dict[str, Dict[str, np.ndarray]],
+                   meta: dict, fault_hook=None) -> dict:
+    """Write one snapshot dir atomically (manifest last).
+
+    ``shards`` maps shard name -> flat ``{key: np.ndarray}`` (the
+    serializer's flattened form; bf16 already widened to exact f32 with
+    the original dtypes recorded in ``meta``).  ``fault_hook`` is the
+    crash-injection point for tests: called as ``fault_hook(stage)``
+    after each shard and before the manifest — raising there leaves
+    exactly the partial state a kill at that byte would.
+
+    Returns stats: ``{"bytes": total, "shards": n}``.
+    """
+    os.makedirs(path, exist_ok=True)
+    # overwriting a committed snapshot (a rollback retry re-saving the
+    # same round): drop the manifest FIRST so a kill mid-rewrite leaves
+    # an uncommitted dir, not a manifest pointing at mixed-age shards
+    mpath = os.path.join(path, MANIFEST)
+    if os.path.exists(mpath):
+        os.remove(mpath)
+    shard_meta: Dict[str, dict] = {}
+    total = 0
+    for name, arrays in shards.items():
+        fpath = os.path.join(path, f"{name}.npz")
+        _replace_write(fpath, lambda f, a=arrays: np.savez(f, **a))
+        size = os.path.getsize(fpath)
+        # the crc is a deliberate read-BACK of the committed file (not a
+        # streaming accumulator: np.savez goes through zipfile, which
+        # seeks back to rewrite local headers, so linear crc-on-write
+        # would checksum bytes that never land); the manifest certifies
+        # what is actually on disk, and the extra read stays on the
+        # writer thread, off the training loop
+        shard_meta[name] = {"file": f"{name}.npz", "bytes": size,
+                            "crc32": _crc32(fpath)}
+        total += size
+        if fault_hook is not None:
+            fault_hook(f"shard:{name}")
+    if fault_hook is not None:
+        fault_hook("manifest")
+    manifest = {"format_version": FORMAT_VERSION, "shards": shard_meta}
+    manifest.update(meta)
+    _replace_write(
+        mpath, lambda f: f.write(
+            json.dumps(manifest, sort_keys=True).encode("utf-8")))
+    return {"bytes": total, "shards": len(shard_meta)}
+
+
+def _read_manifest(path: str) -> Optional[dict]:
+    """Parse ``path``'s manifest when present, well-formed, and of this
+    format version; None otherwise.  The single copy of the
+    open/parse/version check shared by the full validation and the
+    ``assume_valid`` fast path."""
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.isdir(path) or not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if manifest.get("format_version") != FORMAT_VERSION:
+        return None
+    return manifest
+
+
+def validate_snapshot(path: str) -> Optional[dict]:
+    """Return the manifest when ``path`` is a complete, uncorrupted
+    snapshot dir; None otherwise (missing/torn manifest, missing shard,
+    size or crc mismatch — the partial/corrupt states a kill leaves)."""
+    manifest = _read_manifest(path)
+    if manifest is None:
+        return None
+    for name, sm in (manifest.get("shards") or {}).items():
+        fpath = os.path.join(path, sm.get("file", f"{name}.npz"))
+        if not os.path.exists(fpath):
+            return None
+        if os.path.getsize(fpath) != sm.get("bytes"):
+            return None
+        if _crc32(fpath) != sm.get("crc32"):
+            return None
+    return manifest
+
+
+def load_snapshot(path: str, assume_valid: bool = False
+                  ) -> Tuple[dict, Dict[str, Dict[str, np.ndarray]]]:
+    """Load a validated snapshot: (manifest, shard name -> flat arrays).
+    Raises ValueError on a partial/corrupt dir (callers that want to
+    skip instead use :func:`validate_snapshot` first).  ``assume_valid``
+    skips the full shard crc re-read for callers that JUST ran
+    :func:`validate_snapshot` on this path — a multi-GB restore should
+    not read every byte twice (the manifest must still exist and
+    parse)."""
+    manifest = _read_manifest(path) if assume_valid \
+        else validate_snapshot(path)
+    if manifest is None:
+        raise ValueError(
+            f"{path}: not a complete checkpoint snapshot (missing/torn "
+            "manifest or shard checksum mismatch)")
+    shards: Dict[str, Dict[str, np.ndarray]] = {}
+    for name, sm in manifest["shards"].items():
+        with np.load(os.path.join(path, sm["file"]),
+                     allow_pickle=False) as z:
+            shards[name] = {k: z[k] for k in z.files}
+    return manifest, shards
+
+
+def list_snapshots(model_dir: str) -> List[Tuple[int, str]]:
+    """All snapshot candidates in ``model_dir`` — committed or partial
+    ``NNNN.ckpt`` dirs AND legacy ``NNNN.model`` files — as sorted
+    ``(counter, path)`` (ascending).  A counter with both forms lists
+    the ``.ckpt`` dir last (preferred by newest-first consumers)."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return out
+    for n in names:
+        stem, dot, ext = n.rpartition(".")
+        if ext not in ("ckpt", "model") or not stem.isdigit():
+            continue
+        out.append((int(stem), os.path.join(model_dir, n)))
+    # .model sorts before .ckpt for equal counters
+    out.sort(key=lambda t: (t[0], t[1].endswith(".ckpt")))
+    return out
+
+
+def prune_snapshots(model_dir: str, keep: int) -> int:
+    """Retention: delete all but the newest ``keep`` *committed*
+    ``.ckpt`` snapshot dirs (legacy ``.model`` files are untouched —
+    their retention has always been the user's).  Partial dirs older
+    than the newest committed one are swept too (debris from a kill).
+    Returns the number of dirs removed."""
+    keep = max(int(keep), 1)
+    dirs = [(c, p) for c, p in list_snapshots(model_dir)
+            if p.endswith(".ckpt")]
+    committed = [(c, p) for c, p in dirs
+                 if os.path.exists(os.path.join(p, MANIFEST))]
+    removed = 0
+    drop = {p for _, p in committed[:-keep]} if len(committed) > keep \
+        else set()
+    if committed:
+        newest = committed[-1][0]
+        drop |= {p for c, p in dirs
+                 if c < newest
+                 and not os.path.exists(os.path.join(p, MANIFEST))}
+    for p in drop:
+        shutil.rmtree(p, ignore_errors=True)
+        removed += 1
+    return removed

@@ -1,0 +1,154 @@
+"""Async checkpoint writer: snapshot on the train thread, write off it.
+
+The :class:`~cxxnet_tpu.io.device_prefetch.DevicePrefetcher` producer
+thread + bounded-queue discipline, in reverse: the train loop is the
+producer (it hands a fully host-resident snapshot job over a bounded
+queue) and one writer thread is the consumer (npz serialization, crc,
+fsync, the manifest-last commit, retention pruning — the file I/O that
+used to block the step loop for the whole write).
+
+The D2H pull itself stays ON the train thread (``submit`` receives host
+arrays): the jitted train step donates its param/opt/buffer operands, so
+a device array handed to another thread would be deleted by the very
+next update — only a host copy is safe to write concurrently.  What
+moves off-thread is the serialization + disk write, which dominates the
+wall for real models on real filesystems.
+
+Failure discipline mirrors the prefetcher's, in the opposite direction:
+a writer exception **latches** and re-raises on the train thread at the
+next :meth:`submit` / :meth:`poll` / :meth:`close` — a checkpointing run
+whose snapshots silently stopped landing is worse than a dead run.
+``FAULT_HOOK`` is the crash-injection point for the fault tests: set it
+to a callable raising mid-write and the writer dies exactly as a
+SIGKILL at that byte would (partial shard files, no manifest).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from . import prune_snapshots, write_snapshot
+
+#: test-only crash injection: ``FAULT_HOOK(stage)`` is called after each
+#: shard write and before the manifest (stage ``"shard:<name>"`` /
+#: ``"manifest"``); raising simulates a kill at that point
+FAULT_HOOK: Optional[Callable[[str], None]] = None
+
+
+class _Job:
+    __slots__ = ("path", "shards", "meta", "counter", "keep")
+
+    def __init__(self, path: str, shards: Dict[str, Dict[str, np.ndarray]],
+                 meta: dict, counter: int, keep: int):
+        self.path = path
+        self.shards = shards
+        self.meta = meta
+        self.counter = counter
+        self.keep = keep
+
+
+class AsyncCheckpointWriter:
+    """One writer thread + a bounded queue of pending snapshot jobs.
+
+    ``depth`` bounds in-flight host copies (default 1: at most one
+    snapshot being written while the next is prepared — submitting a
+    third blocks the train loop, which is backpressure, not loss).
+    ``on_done(stats)`` runs on the writer thread after each committed
+    snapshot (the task driver emits its ``ckpt`` record there, so the
+    record lands even while the loop is mid-dispatch)."""
+
+    def __init__(self, depth: int = 1, on_done=None):
+        self._queue: "queue.Queue[Optional[_Job]]" = queue.Queue(
+            maxsize=max(int(depth), 1))
+        self._failed: Optional[BaseException] = None
+        self._on_done = on_done
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="cxxnet-ckpt-writer")
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def poll(self) -> None:
+        """Re-raise a latched writer failure on the train thread."""
+        if self._failed is not None:
+            raise self._failed
+
+    def _put(self, item) -> bool:
+        """Bounded put that re-checks the failure latch, so a writer
+        that died with a full queue can never deadlock the train thread
+        (the generation_put discipline, failure-keyed)."""
+        while self._failed is None:
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def submit(self, path: str, shards: Dict[str, Dict[str, np.ndarray]],
+               meta: dict, *, counter: int, keep: int) -> float:
+        """Enqueue one snapshot job (host arrays only); blocks when the
+        bounded queue is full.  Returns the seconds the train thread
+        spent blocked here (reported as ``blocked_sec``)."""
+        self.poll()
+        t0 = time.perf_counter()
+        with self._lock:
+            self._pending += 1
+        if not self._put(_Job(path, shards, meta, counter, keep)):
+            self.poll()  # the writer died while we were blocked
+        return time.perf_counter() - t0
+
+    def drain(self) -> None:
+        """Block until every submitted snapshot committed (or the writer
+        failed — then re-raise).  Called before a rollback restore picks
+        "the last good snapshot", so an in-flight write can't race the
+        scan."""
+        with self._idle:
+            while self._pending > 0 and self._failed is None:
+                self._idle.wait(timeout=0.05)
+        self.poll()
+
+    def close(self) -> None:
+        """Drain, stop, and join the writer; re-raises a latched
+        failure AFTER the thread is joined (callers in finally blocks
+        guard it)."""
+        if self._thread is not None:
+            self._put(None)  # skipped when the writer already died
+            self._thread.join()
+            self._thread = None
+        self.poll()
+
+    # ------------------------------------------------------------- consumer
+    def _run(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                t0 = time.perf_counter()
+                stats = write_snapshot(job.path, job.shards, job.meta,
+                                       fault_hook=FAULT_HOOK)
+                pruned = prune_snapshots(
+                    os.path.dirname(job.path) or ".", job.keep)
+                stats.update(write_sec=time.perf_counter() - t0,
+                             path=job.path, counter=job.counter,
+                             pruned=pruned)
+                if self._on_done is not None:
+                    self._on_done(stats)
+            except BaseException as e:  # noqa: BLE001 — latch for the loop
+                self._failed = e
+                with self._idle:
+                    self._pending = 0
+                    self._idle.notify_all()
+                return
+            with self._idle:
+                self._pending -= 1
+                self._idle.notify_all()

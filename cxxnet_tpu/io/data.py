@@ -77,6 +77,28 @@ class IIterator:
         if base is not None:
             base.close()
 
+    # ----------------------------------------------- resumable position
+    def state(self) -> dict:
+        """JSON-able resume state of this stage + everything beneath it
+        (the checkpoint manifest carries it; doc/checkpoint.md).  The
+        contract is *positional*, like the reference's round-robin
+        restart: stages record where they are (cursor, epoch-done flag,
+        augment rng, cache fill) rather than buffered data.  Only valid
+        at a quiescent point — a round boundary, after the epoch's
+        ``next()`` returned None — so prefetching stages
+        (ThreadBufferIterator, DevicePrefetcher) are drained and their
+        base's position equals the consumer's.  Stages without
+        cross-epoch state just delegate to their base."""
+        base = getattr(self, "base", None)
+        return {"base": base.state()} if base is not None else {}
+
+    def set_state(self, st: dict) -> None:
+        """Restore :meth:`state` (call after ``init()``, before the
+        next ``before_first()``)."""
+        base = getattr(self, "base", None)
+        if base is not None and st and "base" in st:
+            base.set_state(st["base"])
+
     def __iter__(self) -> Iterator:
         self.before_first()
         while True:

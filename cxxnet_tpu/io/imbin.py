@@ -273,6 +273,24 @@ class ImageBinIterator(IIterator):
         self._page_pos = 0
         self._done = False
 
+    def state(self):
+        # the per-epoch shuffle is seeded ``787 + seed_data + gen``, so
+        # the epoch counter IS the cross-round resume state (positions
+        # rewind at each before_first; captured at a round boundary the
+        # producer has exited after its None)
+        return {"gen": int(self._gen)}
+
+    def set_state(self, st):
+        # retire any producer primed before resume state arrived, then
+        # continue the killed run's epoch count so the next epoch's
+        # shuffle order matches the unkilled run's
+        self._gen += 1
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._queue = None
+        self._gen = max(int(st.get("gen", 0)), self._gen)
+
     def close(self):
         self._gen += 1
         if self._thread is not None:
@@ -366,6 +384,7 @@ class ImageIterator(IIterator):
                     np.float32)
                 self.items.append((idx, label, toks[-1]))
         self.order = np.arange(len(self.items))
+        self._epochs = 0
         if not self.silent:
             print(f"ImageIterator: {len(self.items)} images")
 
@@ -373,7 +392,21 @@ class ImageIterator(IIterator):
         if self.shuffle:
             rng = np.random.RandomState(787 + self.seed_data)
             rng.shuffle(self.order)
+            self._epochs += 1
         self._pos = 0
+
+    def state(self):
+        return {"epochs": int(getattr(self, "_epochs", 0))}
+
+    def set_state(self, st):
+        # the epoch-k order is the SAME fixed-seed permutation applied k
+        # times to arange: replay it instead of storing the permutation
+        # (a fresh RandomState(787 + seed_data) shuffles each epoch)
+        k = int(st.get("epochs", 0))
+        self.order = np.arange(len(self.items))
+        for _ in range(k):
+            np.random.RandomState(787 + self.seed_data).shuffle(self.order)
+        self._epochs = k
 
     def next(self):
         if self._pos >= len(self.items):

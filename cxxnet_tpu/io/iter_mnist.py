@@ -95,6 +95,12 @@ class MNISTIterator(IIterator):
     def before_first(self):
         self.loc = 0
 
+    def state(self):
+        return {"loc": int(self.loc)}
+
+    def set_state(self, st):
+        self.loc = int(st.get("loc", 0))
+
     def _view(self, idx: np.ndarray) -> np.ndarray:
         d = self.img[idx]
         n = len(idx)

@@ -74,6 +74,15 @@ class BatchAdaptIterator(IIterator):
             return
         self.base.before_first()
 
+    def state(self):
+        return {"epoch_done": bool(getattr(self, "_epoch_done", False)),
+                "base": self.base.state()}
+
+    def set_state(self, st):
+        self._epoch_done = bool(st.get("epoch_done", False))
+        if "base" in st:
+            self.base.set_state(st["base"])
+
     def _collect(self, n: int) -> List[DataInst]:
         out = []
         while len(out) < n:
@@ -323,6 +332,23 @@ class AugmentIterator(IIterator):
     def before_first(self):
         self.base.before_first()
 
+    def state(self):
+        # the augment rng advances ACROSS epochs — the one piece of
+        # cross-round iterator state an exact resume must restore (a
+        # positional rewind alone would replay round 1's crops/mirrors)
+        name, keys, pos, has_gauss, cached = self.rnd.get_state()
+        return {"rnd": [name, np.asarray(keys).tolist(), int(pos),
+                        int(has_gauss), float(cached)],
+                "base": self.base.state()}
+
+    def set_state(self, st):
+        if "rnd" in st:
+            name, keys, pos, has_gauss, cached = st["rnd"]
+            self.rnd.set_state((name, np.asarray(keys, np.uint32),
+                                int(pos), int(has_gauss), float(cached)))
+        if "base" in st:
+            self.base.set_state(st["base"])
+
     def next(self):
         inst = self.base.next()
         if inst is None:
@@ -442,6 +468,19 @@ class ThreadBufferIterator(IIterator):
             raise v.exc
         return v
 
+    def set_state(self, st):
+        # quiesce the producer BEFORE touching the shared base (init()
+        # primes a producer that is already reading it); the next
+        # before_first() rewinds and restarts as usual, with the base's
+        # cross-epoch state (augment rng, cache fill) restored
+        self._gen += 1
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._queue = None
+        if "base" in st:
+            self.base.set_state(st["base"])
+
     def close(self):
         self._gen += 1
         if self._thread is not None:
@@ -462,6 +501,7 @@ class DenseBufferIterator(IIterator):
         self._cache: List[DataBatch] = []
         self._filled = False
         self._pos = 0
+        self._prefill_base = None
 
     def set_param(self, name, val):
         if name == "max_nbatch":
@@ -475,7 +515,48 @@ class DenseBufferIterator(IIterator):
     def before_first(self):
         self._pos = 0
         if not self._filled:
+            # a producer stage above (threadbuffer) primes its thread at
+            # init() and pulls a partial fill through us before the first
+            # real epoch; rewinding the base under that partial cache
+            # would pair each remaining item with the wrong rng draw —
+            # drop it and restart the fill cleanly
+            self._cache = []
+            # the base's state at the instant the fill starts: a resumed
+            # run rewinds to it before rebuilding the cache, so the
+            # rebuild replays the ORIGINAL fill's rng draws
+            self._prefill_base = self.base.state()
             self.base.before_first()
+
+    def state(self):
+        st = {"filled": bool(self._filled), "pos": int(self._pos),
+              "base": self.base.state()}
+        if self._prefill_base is not None:
+            st["prefill_base"] = self._prefill_base
+        return st
+
+    def set_state(self, st):
+        if st.get("prefill_base") is not None:
+            self._prefill_base = st["prefill_base"]
+        if st.get("filled") and not self._filled:
+            # rebuild the cache deterministically (the original fill read
+            # the base's first max_nbatch batches; after the fill the
+            # base is never read again).  A producer stage above may
+            # already have pulled through us before resume state arrived
+            # (ThreadBufferIterator.init primes its thread): drop those
+            # pulls and rewind the base to its recorded pre-fill state so
+            # the rebuild reproduces the original cache — same batches,
+            # same augment rng draws
+            self._cache = []
+            self._pos = 0
+            if self._prefill_base is not None:
+                self.base.set_state(self._prefill_base)
+            self.base.before_first()
+            while not self._filled and self.next() is not None:
+                pass
+            self._filled = True
+        self._pos = int(st.get("pos", 0))
+        if "base" in st:
+            self.base.set_state(st["base"])
 
     def next(self):
         if self._filled:

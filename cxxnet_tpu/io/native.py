@@ -141,6 +141,17 @@ class NativeImageBinIterator(IIterator):
         assert self._h is not None, "init() must be called first"
         self._lib.CXNIONativeBeforeFirst(self._h)
 
+    def state(self):
+        # the shuffle/cursor state lives C++-side with no capture API:
+        # raising (instead of the silent {} default) makes the
+        # checkpoint path warn that this iterator resumes cold
+        raise NotImplementedError(
+            "native iterator state lives in C++; resume restarts it cold")
+
+    def set_state(self, st):
+        raise NotImplementedError(
+            "native iterator state lives in C++; resume restarts it cold")
+
     def next(self) -> Optional[DataBatch]:
         u8 = bool(self._lib.CXNIONativeIsU8(self._h))
         label = np.empty((self.batch_size, self.label_width), np.float32)

@@ -16,16 +16,19 @@ from __future__ import annotations
 import os
 import re
 import sys
+import threading
 import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from . import ckpt as ckptlib
 from .analysis.schema import K
+from .ckpt import CKPT_KEYS
 from .serve import SERVE_KEYS
 from .io.device_prefetch import DevicePrefetcher, StagedGroup, item_h2d_sec
 from .io.factory import create_iterator, init_iterator
-from .monitor import log as mlog
+from .monitor import TrainingDiverged, log as mlog
 from .monitor.trace import ProfileWindow
 from .nnet.trainer import NetTrainer
 from .utils.config import parse_config_file, parse_keyval_args
@@ -73,8 +76,9 @@ TASK_KEYS = (
     K("dist_num_proc", "int", lo=1),
     K("dist_proc_rank", "int", lo=0),
     # serving keys (serve/__init__.py declares them next to their
-    # consumer, ServeConfig.from_pairs; doc/serve.md)
-) + SERVE_KEYS
+    # consumer, ServeConfig.from_pairs; doc/serve.md) and checkpoint /
+    # rollback keys (ckpt/__init__.py; doc/checkpoint.md)
+) + SERVE_KEYS + CKPT_KEYS
 
 
 class LearnTask:
@@ -125,6 +129,25 @@ class LearnTask:
         self.sentinel_warmup = 3
         self.sentinel_ring = 64
         self._sentinel_bank = None
+        # fault-tolerant checkpoints (doc/checkpoint.md): ckpt_async=1
+        # snapshots at round boundaries into atomic NNNN.ckpt dirs off
+        # the training thread; save_opt carries optimizer state (exact
+        # resume); ckpt_iter_state carries the train-iterator chain
+        # state; ckpt_keep bounds retention; rollback=N auto-restores
+        # the last good snapshot on TrainingDiverged and retries
+        self.ckpt_async = 0
+        self.ckpt_keep = 3
+        self.rollback = 0
+        self.save_opt = 1
+        self.ckpt_iter_state = 1
+        self._ckpt_writer = None
+        self._ckpt_blocked_sec: dict = {}
+        # guards _ckpt_blocked_sec: the train thread writes entries
+        # around submit() while _ckpt_done pops them on the writer thread
+        self._ckpt_lock = threading.Lock()
+        self._resume_iter_state = None
+        self._resume_sentinel_state = None
+        self._warned_iter_capture = False
         # instruction->scope join, cached like trainer._step_hlo_cache:
         # recurring prof_every windows must not re-scan the HLO text
         self._op_scopes_cache = None
@@ -199,6 +222,16 @@ class LearnTask:
             self.sentinel_warmup = int(val)
         elif name == "sentinel_ring":
             self.sentinel_ring = int(val)
+        elif name == "ckpt_async":
+            self.ckpt_async = int(val)
+        elif name == "ckpt_keep":
+            self.ckpt_keep = max(int(val), 1)
+        elif name == "rollback":
+            self.rollback = int(val)
+        elif name == "save_opt":
+            self.save_opt = int(val)
+        elif name == "ckpt_iter_state":
+            self.ckpt_iter_state = int(val)
         elif name == "test_on_server":
             self.test_on_server = int(val)
         elif name == "output_format":
@@ -218,26 +251,88 @@ class LearnTask:
         return net
 
     def _sync_latest_model(self) -> bool:
-        last = None
-        # also accept snapshot dirs whose numbering starts one above
-        # start_counter (directories saved before the default moved to the
-        # reference's 0 have 0001.model as their first snapshot)
-        for s0 in (self.start_counter, self.start_counter + 1):
-            s = s0
-            while True:
-                name = os.path.join(self.name_model_dir, f"{s:04d}.model")
-                if not os.path.exists(name):
-                    break
-                last = name
-                s += 1
-            if last is not None:
-                break
-        if last is None:
-            return False
-        self.net = self._create_net()
-        self.net.load_model(last)
-        self.start_counter = s
-        return True
+        """SyncLastestModel (cxxnet_main.cpp:135-157), hardened: scan
+        ``model_dir`` for the newest *loadable* snapshot — ``NNNN.ckpt``
+        atomic dirs and legacy ``NNNN.model`` files — newest first,
+        SKIPPING partial/corrupt ones (a manifest-less or
+        checksum-failing dir is what a kill mid-write leaves; the
+        previous snapshot is the resume point, and the next save
+        overwrites the debris)."""
+        cands = [(c, p) for c, p in
+                 ckptlib.list_snapshots(self.name_model_dir)
+                 if c >= self.start_counter]
+        # same finite-params gate as rollback: a rollback that walked
+        # past a NaN-poisoned snapshot leaves it on disk (crc-valid,
+        # loadable) — a restart must not resume from it either
+        return self._restore_newest_valid(
+            cands, who="continue",
+            reject=self._reject_nonfinite) is not None
+
+    @staticmethod
+    def _reject_nonfinite(net):
+        """Reject hook for the resume scans: the divergence may predate
+        a snapshot, and poisoned params would just diverge again."""
+        import jax
+        finite = all(bool(np.isfinite(np.asarray(leaf)).all())
+                     for leaf in jax.tree.leaves(net.params))
+        return None if finite else "carries non-finite params; walking back"
+
+    def _restore_newest_valid(self, cands, who: str, reject=None):
+        """Walk ``(counter, path)`` candidates NEWEST-first and restore
+        the first loadable one into ``self.net``: partial/corrupt
+        ``.ckpt`` dirs (what a kill mid-write leaves) are skipped with a
+        warning, torn legacy files are skipped at load, and ``reject``
+        — given the loaded trainer, returning a reason string or None —
+        lets the rollback path refuse poisoned snapshots.  Shared by
+        ``continue = 1`` and rollback so the two resume paths cannot
+        drift.  Sets ``start_counter`` past the restored round, stashes
+        iterator/sentinel resume state, and returns ``(counter, path)``
+        or None."""
+        for counter, path in reversed(cands):
+            is_ckpt = path.endswith(".ckpt")
+            if is_ckpt and ckptlib.validate_snapshot(path) is None:
+                mlog.warn(f"{who}: skipping partial/corrupt snapshot "
+                          f"{path}")
+                continue
+            net = self._create_net()
+            try:
+                net.load_model(path, validated=is_ckpt)
+            except Exception as e:  # noqa: BLE001 — torn legacy file
+                net.metrics.close()
+                mlog.warn(f"{who}: snapshot {path} failed to load "
+                          f"({e}); trying the previous one")
+                continue
+            why = reject(net) if reject is not None else None
+            if why:
+                net.metrics.close()
+                mlog.warn(f"{who}: snapshot {path} {why}")
+                continue
+            old, self.net = self.net, net
+            if old is not None and old is not net:
+                old.metrics.close()
+            self.start_counter = counter + 1
+            self._stash_resume_state(net.loaded_extra)
+            return counter, path
+        return None
+
+    def _stash_resume_state(self, extra) -> None:
+        """Hold a loaded snapshot's iterator / sentinel state until the
+        consumers exist (iterators after ``_create_iterators``, the
+        sentinel bank inside the train loop)."""
+        if not extra:
+            return
+        if self.ckpt_iter_state:
+            self._resume_iter_state = extra.get("iter_state")
+        self._resume_sentinel_state = extra.get("sentinel_state")
+
+    def _apply_iter_resume(self) -> None:
+        st, self._resume_iter_state = self._resume_iter_state, None
+        if st and self.itr_train is not None:
+            try:
+                self.itr_train.set_state(st)
+            except Exception as e:  # noqa: BLE001 — resume best-effort
+                mlog.warn(f"iterator state restore failed ({e}); the "
+                          "train iterator resumes cold")
 
     def _maybe_init_distributed(self) -> None:
         """Join the JAX distributed runtime when a coordinator is configured
@@ -270,6 +365,7 @@ class LearnTask:
                 mlog.notice(
                     f"Init: Continue training from round {self.start_counter}")
                 self._create_iterators()
+                self._apply_iter_resume()
                 return
             raise RuntimeError(
                 "Init: cannot find models for continue training; "
@@ -286,7 +382,7 @@ class LearnTask:
         else:
             self.net = self._create_net()
             self.net.load_model(self.name_model_in)
-            m = re.search(r"(\d+)\.model$", self.name_model_in)
+            m = re.search(r"(\d+)\.(?:model|ckpt)$", self.name_model_in)
             if m:
                 self.start_counter = int(m.group(1)) + 1
         self._create_iterators()
@@ -440,20 +536,180 @@ class LearnTask:
             mlog.warn(f"layer attribution failed: {e}")
 
     # ---------------------------------------------------------------- tasks
-    def _save_model(self) -> None:
+    def _ckpt_extra_state(self, capture_iter: bool = True) -> dict:
+        """Non-trainer resume state riding in the snapshot: the train
+        iterator chain's position/rng state (quiescent at a round
+        boundary — the epoch's prefetchers have drained) and the
+        sentinel EWMA/ring state.  ``capture_iter = False`` for the
+        initial round-0 save: a threadbuffer's init()-primed producer is
+        still pulling there, so state() would read racing cursors/rng —
+        and a fresh iterator resuming cold IS its round-0 state."""
+        extra = {}
+        if capture_iter and self.ckpt_iter_state \
+                and self.itr_train is not None:
+            try:
+                extra["iter_state"] = self.itr_train.state()
+            except Exception as e:  # noqa: BLE001 — snapshot best-effort
+                if not self._warned_iter_capture:
+                    self._warned_iter_capture = True
+                    mlog.warn(f"iterator state capture failed ({e}); "
+                              "snapshots resume the iterator cold")
+        if self._sentinel_bank is not None:
+            extra["sentinel_state"] = self._sentinel_bank.state()
+        return extra
+
+    def _ckpt_done(self, stats: dict) -> None:
+        """Writer-thread completion hook: the ``ckpt`` record lands as
+        soon as the manifest committed, even while the train loop is
+        mid-dispatch."""
+        metrics = self.net.metrics
+        with self._ckpt_lock:
+            blocked = self._ckpt_blocked_sec.pop(stats["counter"], 0.0)
+        metrics.counter_inc("ckpt_saves")
+        metrics.emit("ckpt", round=stats["counter"], path=stats["path"],
+                     async_write=1, shards=stats["shards"],
+                     bytes=stats["bytes"],
+                     write_sec=round(stats["write_sec"], 4),
+                     blocked_sec=round(blocked, 4),
+                     pruned=stats["pruned"], keep=self.ckpt_keep)
+        mlog.info(f"checkpoint {stats['path']}: {stats['bytes']} bytes "
+                  f"in {stats['write_sec']:.3f} sec off-thread "
+                  f"(loop blocked {blocked:.3f} sec)")
+
+    def _save_model(self, capture_iter: bool = True) -> None:
+        if self._ckpt_writer is not None:
+            # a writer failure latched since the last save surfaces at
+            # the next round boundary, not silently at process exit
+            self._ckpt_writer.poll()
         counter = self.start_counter
         self.start_counter += 1
         if self.save_period == 0 or counter % self.save_period != 0:
             return
         os.makedirs(self.name_model_dir, exist_ok=True)
+        extra_state = self._ckpt_extra_state(capture_iter)
+        metrics = self.net.metrics
+        t0 = time.perf_counter()
+        if self.ckpt_async:
+            # async atomic snapshot: host pull on this thread (the
+            # jitted step donates the device buffers), npz + manifest
+            # commit + retention on the writer thread.  submit() blocks
+            # only when a previous write is still in flight
+            # (bounded-queue backpressure) and re-raises any latched
+            # writer failure here, in the train loop
+            from .ckpt.writer import AsyncCheckpointWriter
+            if self._ckpt_writer is None:
+                self._ckpt_writer = AsyncCheckpointWriter(
+                    on_done=self._ckpt_done)
+            shards, meta = self.net.checkpoint_payload(
+                with_opt=bool(self.save_opt), extra_state=extra_state)
+            path = ckptlib.snapshot_path(self.name_model_dir, counter)
+            # stash the host-pull wall BEFORE submit so the completion
+            # hook (writer thread) always finds an entry; fold in the
+            # backpressure block after, if the record hasn't landed yet
+            pull = time.perf_counter() - t0
+            with self._ckpt_lock:
+                self._ckpt_blocked_sec[counter] = pull
+            block = self._ckpt_writer.submit(
+                path, shards, meta, counter=counter, keep=self.ckpt_keep)
+            with self._ckpt_lock:
+                # the record may already have landed (fast writer): then
+                # the entry is gone and its blocked_sec missed the submit
+                # block — never re-insert, that entry would leak
+                if counter in self._ckpt_blocked_sec:
+                    self._ckpt_blocked_sec[counter] = pull + block
+            return
+        # legacy single-file path, now atomic (tmp + os.replace) and
+        # carrying opt state + exact-resume state by default
         path = os.path.join(self.name_model_dir, f"{counter:04d}.model")
-        self.net.save_model(path)
+        self.net.save_model(path, with_opt_state=bool(self.save_opt),
+                            extra_state=extra_state)
+        wall = time.perf_counter() - t0
+        metrics.counter_inc("ckpt_saves")
+        metrics.emit("ckpt", round=counter, path=path, async_write=0,
+                     shards=1, bytes=os.path.getsize(path),
+                     write_sec=round(wall, 4), blocked_sec=round(wall, 4),
+                     pruned=0, keep=self.ckpt_keep)
 
     def task_train(self) -> None:
+        """``task = train``: the train loop under the rollback guard.
+
+        ``rollback = N`` closes the fault-tolerance loop: on
+        ``TrainingDiverged`` (the ``monitor_nan = fatal`` guard, or any
+        sentinel-confirmed NaN that escalates to it) the task restores
+        the newest snapshot whose params are finite, reseeds the rng
+        stream past the bad window (``NetTrainer.reseed_rng`` — the
+        retried rounds draw different randomness, and later snapshots
+        carry the folded key so their own resume stays exact), and
+        re-enters the loop, up to N times before re-raising."""
+        attempt = 0
+        try:
+            while True:
+                try:
+                    self._run_train_loop(initial_save=(attempt == 0))
+                    break
+                except TrainingDiverged as e:
+                    if attempt >= self.rollback \
+                            or not self._rollback_restore(e, attempt + 1):
+                        raise
+                    attempt += 1
+            if self._ckpt_writer is not None:
+                # drain + close on the success path OUTSIDE the finally:
+                # a latched writer failure must fail the run (snapshots
+                # silently not landing is the worst outcome)
+                w, self._ckpt_writer = self._ckpt_writer, None
+                w.close()
+        finally:
+            if self._ckpt_writer is not None:  # exception path: don't
+                w, self._ckpt_writer = self._ckpt_writer, None  # mask
+                try:
+                    w.close()
+                except Exception as ce:  # noqa: BLE001
+                    mlog.warn(f"checkpoint writer close failed: {ce}")
+
+    def _rollback_restore(self, exc: BaseException, attempt: int) -> bool:
+        """Restore the newest loadable snapshot with all-finite params;
+        returns False when none exists (the caller re-raises).  Emits a
+        ``rollback`` record and resets ``start_counter`` so the loop
+        re-enters at the restored round."""
+        died_round = self.start_counter
+        if self._ckpt_writer is not None:
+            # an in-flight write must commit (or fail) before "newest
+            # snapshot" means anything.  A latched writer failure
+            # re-raises HERE, before any restore work: per the writer's
+            # discipline it must fail the run, and retrying would only
+            # hit the same latch at the retry's first _save_model poll
+            self._ckpt_writer.drain()
+        cands = [(c, p) for c, p in
+                 ckptlib.list_snapshots(self.name_model_dir)
+                 if c < died_round]
+        restored = self._restore_newest_valid(
+            cands, who="rollback", reject=self._reject_nonfinite)
+        if restored is None:
+            mlog.warn(f"rollback: no finite snapshot found in "
+                      f"{self.name_model_dir}; re-raising")
+            return False
+        counter, path = restored
+        self.net.reseed_rng(attempt)
+        self._apply_iter_resume()
+        self.net.metrics.counter_inc("rollbacks")
+        self.net.metrics.emit(
+            "rollback", retry=attempt, max_retry=self.rollback,
+            from_round=died_round, restored_round=counter,
+            path=path, reason=f"{type(exc).__name__}: {exc}")
+        mlog.result(
+            f"rollback {attempt}/{self.rollback}: {type(exc).__name__} "
+            f"in round {died_round}; restored {path}, reseeded rng, "
+            f"resuming from round {self.start_counter}")
+        return True
+
+    def _run_train_loop(self, initial_save: bool = True) -> None:
         start = time.time()
         metrics = self.net.metrics
-        if self.continue_training == 0 and self.name_model_in == "NULL":
-            self._save_model()
+        if initial_save and self.continue_training == 0 \
+                and self.name_model_in == "NULL":
+            # round-0 save: the iterator chain is NOT quiescent yet (a
+            # threadbuffer's producer primed at init() is mid-pull)
+            self._save_model(capture_iter=False)
         if self.synth_device_data:
             self._train_synth_device()
             return
@@ -480,6 +736,11 @@ class LearnTask:
             self._sentinel_bank = SentinelBank(
                 metrics, rel=self.sentinel_rel,
                 warmup=self.sentinel_warmup, ring=self.sentinel_ring)
+            if self._resume_sentinel_state:
+                # resumed run continues the pre-kill EWMA baselines
+                # instead of re-warming from scratch
+                self._sentinel_bank.set_state(self._resume_sentinel_state)
+                self._resume_sentinel_state = None
         elif self.sentinel:
             # every sentinel output goes to the sink; armed without one
             # it would only add a per-print-step D2H loss sync (lint

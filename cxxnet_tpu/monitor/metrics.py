@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import math
 import random
+import threading
 import time
 from typing import Any, Dict, List, Optional, TextIO
 
@@ -91,14 +92,21 @@ class JsonlSink:
     def __init__(self, path: str):
         self.path = path
         self._fo: TextIO = open(path, "a")
+        # the async checkpoint writer emits its `ckpt` record from the
+        # writer thread while the train loop emits step records; a
+        # buffered TextIOWrapper is not thread-safe, so serialize writes
+        # or two records can interleave mid-line (torn JSONL)
+        self._lock = threading.Lock()
 
     def write(self, record: Dict[str, Any]) -> None:
-        self._fo.write(json.dumps(record, sort_keys=True,
-                                  default=_jsonable) + "\n")
-        self._fo.flush()  # records must survive a fatal NaN abort
+        line = json.dumps(record, sort_keys=True, default=_jsonable) + "\n"
+        with self._lock:
+            self._fo.write(line)
+            self._fo.flush()  # records must survive a fatal NaN abort
 
     def close(self) -> None:
-        self._fo.close()
+        with self._lock:
+            self._fo.close()
 
 
 def _jsonable(v):

@@ -113,6 +113,28 @@ class SentinelBank:
         }
         self.anomalies: List[Dict] = []
 
+    # ---------------------------------------------------- resume state
+    def state(self) -> Dict:
+        """JSON-able resume state (the checkpoint manifest carries it):
+        per-series EWMA mean + observation count, plus the flight ring.
+        Without this a resumed run re-warms its baselines from scratch
+        and the first post-resume rounds can neither fire nor extend a
+        pre-kill trend."""
+        return {"sentinels": {k: {"mean": s.ewma.mean, "seen": s.seen}
+                              for k, s in self.sentinels.items()},
+                "ring": list(self.ring)}
+
+    def set_state(self, st: Dict) -> None:
+        for k, sv in (st.get("sentinels") or {}).items():
+            s = self.sentinels.get(k)
+            if s is None:
+                continue
+            mean = sv.get("mean")
+            s.ewma.mean = None if mean is None else float(mean)
+            s.seen = int(sv.get("seen", 0))
+        for rec in st.get("ring") or []:
+            self.ring.append(rec)
+
     # ------------------------------------------------------------ hooks
     def observe_step(self, rec: Dict) -> None:
         self.ring.append(dict(rec, kind="step"))

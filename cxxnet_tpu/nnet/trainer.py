@@ -175,6 +175,9 @@ class NetTrainer:
         self.net: Optional[Network] = None
         self._train_step = None
         self._eval_step_cache: Dict[Tuple[int, ...], Any] = {}
+        # header "extra" of the last load_model (iterator/sentinel state
+        # for the task driver's exact resume); None on a fresh init
+        self.loaded_extra: Optional[Dict] = None
 
     # ------------------------------------------------------------------ cfg
     def set_param(self, name: str, val: str) -> None:
@@ -2030,7 +2033,93 @@ class NetTrainer:
             rec(self.params[k], self.opt_state[k])
 
     # ---------------------------------------------------------- checkpoints
-    def save_model(self, path: str, *, with_opt_state: bool = False) -> None:
+    def train_state(self) -> Dict[str, Any]:
+        """The non-array state exact resume needs: counters plus the LIVE
+        rng stream.  The raw PRNG key (not the seed) matters — a
+        rollback retry reseeds the stream past the bad window, and the
+        resumed run must continue *that* stream, not the seed's."""
+        return {"sample_counter": int(self.sample_counter),
+                "epoch_counter": int(self.epoch_counter),
+                "round": int(self.round), "seed": int(self.seed),
+                "rng_key": np.asarray(self._rng_base).tolist(),
+                "rng_dtype": str(np.asarray(self._rng_base).dtype)}
+
+    def set_train_state(self, st: Dict[str, Any]) -> None:
+        self.sample_counter = int(st["sample_counter"])
+        self.epoch_counter = int(st["epoch_counter"])
+        self.round = int(st["round"])
+        self._rng_base = jnp.asarray(
+            np.asarray(st["rng_key"], dtype=st.get("rng_dtype", "uint32")))
+
+    def reseed_rng(self, salt: int) -> None:
+        """Fold a salt into the CURRENT rng base — the rollback path's
+        "reseed past the bad window": the retried rounds draw different
+        dropout/augment randomness, while a later checkpoint of the
+        retry carries the folded key so its own resume stays exact."""
+        self._rng_base = jax.random.fold_in(self._rng_base,
+                                            np.uint32(7919 + salt))
+
+    def _host_tree(self, tree):
+        """Device pytree -> independent host copies.  ``np.array`` (not
+        ``asarray``): the jitted step donates its operands, and a
+        zero-copy view into a donated CPU buffer would be silently
+        rewritten while the async writer serializes it."""
+        return jax.tree.map(lambda a: np.array(np.asarray(a)), tree)
+
+    def checkpoint_payload(self, *, with_opt: bool = True,
+                           extra_state: Optional[Dict] = None
+                           ) -> Tuple[Dict[str, Dict[str, np.ndarray]],
+                                      Dict[str, Any]]:
+        """One snapshot's (shards, manifest-meta): flat host-array shards
+        (``params`` / ``buffers`` / ``opt``) plus everything the
+        manifest carries for exact resume.  Runs on the train thread (a
+        host pull — the donated device buffers can't cross threads);
+        the returned arrays are independent copies safe to hand to the
+        async writer."""
+        dtypes: Dict[str, str] = {}
+        # each shard keeps the legacy "group/key" namespace (its own
+        # top-level prefix), so the shared dtypes map can never collide
+        # across shards
+        shards = {"params": serializer.flatten_tree(
+            {"params": self._host_tree(self.params)}, dtypes)}
+        buf = serializer.flatten_tree(
+            {"buffers": self._host_tree(self.buffers)}, dtypes)
+        if buf:
+            shards["buffers"] = buf
+        if with_opt:
+            shards["opt"] = serializer.flatten_tree(
+                {"opt": self._host_tree(self.opt_state)}, dtypes)
+        # a round boundary mid-accumulation (update_period > 1, batches
+        # per round not a multiple): the pending local gradient sums are
+        # trajectory state too.  The dp_reduce_at=apply accumulator is
+        # mesh-shaped (leading device axis) and can't reshard — skipped
+        # with a warning (resume is exact only at apply boundaries there)
+        pending = self.sample_counter % self.update_period
+        if pending and getattr(self, "_grad_acc", None) is not None:
+            if getattr(self, "_overlap_defer", False):
+                mlog.warn(
+                    "checkpoint at a mid-accumulation boundary with "
+                    "dp_reduce_at = apply: the device-local accumulator "
+                    "is not portable; resume replays the partial window "
+                    "inexactly")
+            else:
+                shards["acc"] = serializer.flatten_tree(
+                    {"acc": self._host_tree(self._grad_acc)}, dtypes)
+        extra = {"round": int(self.round),
+                 "train_state": self.train_state()}
+        if extra_state:
+            extra.update(extra_state)
+        meta = {"net": self.netcfg.to_dict(),
+                "epoch": int(self.epoch_counter),
+                "has_opt_state": with_opt, "dtypes": dtypes,
+                "extra": extra}
+        return shards, meta
+
+    def save_model(self, path: str, *, with_opt_state: bool = False,
+                   extra_state: Optional[Dict] = None) -> None:
+        extra = {"round": self.round, "train_state": self.train_state()}
+        if extra_state:
+            extra.update(extra_state)
         serializer.save_model(
             path, net_structure=self.netcfg.to_dict(),
             epoch=self.epoch_counter,
@@ -2038,11 +2127,35 @@ class NetTrainer:
             buffers=jax.tree.map(np.asarray, self.buffers),
             opt_state=jax.tree.map(np.asarray, self.opt_state)
             if with_opt_state else None,
-            extra_meta={"round": self.round})
+            extra_meta=extra)
 
-    def load_model(self, path: str) -> None:
+    def load_model(self, path: str, validated: bool = False) -> None:
         mlog.set_silent(self.silent)
-        header, params, buffers, opt = serializer.load_model(path)
+        import os
+        if os.path.isdir(path):
+            # atomic snapshot dir (ckpt_async): shards + manifest.
+            # ``validated`` = the caller just ran validate_snapshot (the
+            # resume/rollback scans do) — skip the second full crc read
+            from .. import ckpt
+            manifest, shard_arrays = ckpt.load_snapshot(
+                path, assume_valid=validated)
+            dtypes = manifest.get("dtypes") or {}
+            header = {"net": manifest["net"], "epoch": manifest["epoch"],
+                      "has_opt_state": manifest.get("has_opt_state"),
+                      "extra": manifest.get("extra", {})}
+            params = serializer.unflatten_tree(
+                shard_arrays.get("params", {}), dtypes).get("params", {})
+            buffers = serializer.unflatten_tree(
+                shard_arrays.get("buffers", {}), dtypes).get("buffers", {})
+            opt = serializer.unflatten_tree(
+                shard_arrays.get("opt", {}), dtypes).get("opt") \
+                if header["has_opt_state"] else None
+            acc = serializer.unflatten_tree(
+                shard_arrays.get("acc", {}), dtypes).get("acc") \
+                if "acc" in shard_arrays else None
+        else:
+            header, params, buffers, opt = serializer.load_model(path)
+            acc = None
         netcfg = NetConfig.from_dict(header["net"])
         # re-apply the current session's config on top of the checkpoint's:
         # later pairs win inside set_param consumers, so CLI overrides like
@@ -2067,6 +2180,24 @@ class NetTrainer:
         if opt is not None:
             self.opt_state = jax.device_put(
                 jax.tree.map(jnp.asarray, opt), self.opt_shardings)
+        if acc is not None:
+            self._grad_acc = jax.device_put(
+                jax.tree.map(jnp.asarray, acc), self.param_shardings)
+        # exact resume: snapshots written by this codebase carry the
+        # live counters + rng stream — restore them so the resumed
+        # trajectory continues bitwise (fold_in(rng_base,
+        # sample_counter) keys every step).  Older .model files without
+        # a train_state approximate sample_counter from the epoch (exact
+        # at update_period = 1; the rng base stays seed-derived either
+        # way, which matches any run that never rolled back)
+        ts = header["extra"].get("train_state")
+        if ts is not None:
+            self.set_train_state(ts)
+        else:
+            self.sample_counter = self.epoch_counter * self.update_period
+        # iterator / sentinel state for the task driver to re-apply
+        # (cleared by _post_build's counters reset above, so set last)
+        self.loaded_extra = dict(header["extra"])
 
     def copy_model_from(self, path: str) -> None:
         """Finetune: copy weights for layers whose name and shapes match

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -77,8 +78,57 @@ def save_model(path: str, *, net_structure: dict, epoch: int,
     }
     arrays["__header__"] = np.frombuffer(
         json.dumps(header).encode("utf-8"), dtype=np.uint8)
-    with open(path, "wb") as f:
-        np.savez(f, **arrays)
+    # atomic single-file save: a kill mid-write can never leave a
+    # half-written newest snapshot for continue=1 to load — the file is
+    # either the old complete one or the new complete one
+    atomic_write(path, lambda f: np.savez(f, **arrays))
+
+
+def atomic_write(path: str, write_fn) -> None:
+    """Write via ``<path>.tmp`` + fsync + ``os.replace`` — observers see
+    either the old complete file or the new complete one, never a
+    half-write.  The tmp file is removed when ``write_fn`` raises.
+    Shared by the legacy single-file save and the ckpt snapshot shards
+    (one copy of the durability protocol).  The containing directory is
+    fsynced after the replace: without it the rename itself is not
+    durable against power loss, and a checkpoint whose manifest rename
+    evaporates on remount while retention already pruned its
+    predecessor would leave no loadable snapshot at all."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        except OSError:  # platform without directory fds
+            return
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def flatten_tree(tree: Dict, dtypes: Dict[str, str]) -> Dict[str, np.ndarray]:
+    """Public flatten for the ckpt shard writer: nested tree ->
+    ``{"a/b/c": np.ndarray}`` with ml_dtypes extension types widened to
+    exact float32 and recorded in ``dtypes`` (same contract as
+    save_model's arrays)."""
+    return _flatten(tree, dtypes=dtypes)
+
+
+def unflatten_tree(flat: Dict[str, np.ndarray],
+                   dtypes: Dict[str, str] = None) -> Dict:
+    """Inverse of :func:`flatten_tree` (restores recorded dtypes)."""
+    return _unflatten(flat, dtypes)
 
 
 def load_model(path: str) -> Tuple[dict, Dict, Dict, Dict]:

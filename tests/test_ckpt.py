@@ -1,0 +1,823 @@
+"""Fault-tolerant checkpoints: atomic snapshots, exact resume, rollback.
+
+The acceptance surface of doc/checkpoint.md:
+
+* a kill at ANY byte of a checkpoint write leaves the previous snapshot
+  loadable and the new one detectably partial (manifest-last protocol);
+* ``continue = 1`` skips partial/corrupt snapshots and resumes from the
+  newest valid one;
+* a run killed mid-training and resumed reproduces the unkilled run's
+  params / opt state / rng / iterator trajectory BITWISE at f32 on CPU;
+* a snapshot saved on a ``data:2`` mesh restores onto 1 device (and
+  vice versa) by resharding the host shards;
+* ``rollback = N`` survives a NaN-poisoned batch: restore, reseed,
+  retry, complete.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import cxxnet_tpu.ckpt as ckptlib
+import cxxnet_tpu.ckpt.writer as ckpt_writer
+from cxxnet_tpu.ckpt.writer import AsyncCheckpointWriter
+from cxxnet_tpu.io.data import IIterator
+from cxxnet_tpu.main import LearnTask
+from cxxnet_tpu.monitor import TrainingDiverged
+from cxxnet_tpu.utils.config import parse_config_file, parse_keyval_args
+
+
+# ------------------------------------------------------- snapshot format
+
+def _shards(seed=0):
+    rnd = np.random.RandomState(seed)
+    return {"params": {"params/fc1/wmat": rnd.rand(4, 3).astype(np.float32),
+                       "params/fc1/bias": rnd.rand(3).astype(np.float32)},
+            "opt": {"opt/fc1/wmat/mom": np.zeros((4, 3), np.float32)}}
+
+
+def _meta(round_=1):
+    return {"net": {}, "epoch": round_, "has_opt_state": True,
+            "dtypes": {}, "extra": {"round": round_}}
+
+
+def test_snapshot_roundtrip(tmp_path):
+    path = str(tmp_path / "0001.ckpt")
+    stats = ckptlib.write_snapshot(path, _shards(), _meta())
+    assert stats["shards"] == 2 and stats["bytes"] > 0
+    manifest = ckptlib.validate_snapshot(path)
+    assert manifest is not None and manifest["epoch"] == 1
+    m2, arrays = ckptlib.load_snapshot(path)
+    for shard, flat in _shards().items():
+        for k, v in flat.items():
+            np.testing.assert_array_equal(arrays[shard][k], v)
+    assert not [n for n in os.listdir(path) if n.endswith(".tmp")]
+
+
+def test_snapshot_corruption_detected(tmp_path):
+    path = str(tmp_path / "0001.ckpt")
+    ckptlib.write_snapshot(path, _shards(), _meta())
+    # flip bytes in a shard: crc mismatch
+    f = os.path.join(path, "params.npz")
+    data = bytearray(open(f, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(f, "wb").write(bytes(data))
+    assert ckptlib.validate_snapshot(path) is None
+    with pytest.raises(ValueError):
+        ckptlib.load_snapshot(path)
+    # a torn manifest is also invalid
+    path2 = str(tmp_path / "0002.ckpt")
+    ckptlib.write_snapshot(path2, _shards(), _meta(2))
+    mp = os.path.join(path2, ckptlib.MANIFEST)
+    open(mp, "wb").write(open(mp, "rb").read()[:20])
+    assert ckptlib.validate_snapshot(path2) is None
+
+
+def test_kill_mid_write_preserves_previous(tmp_path):
+    """A crash between the shard writes and the manifest commit leaves
+    the previous snapshot valid and the new dir uncommitted."""
+    prev = str(tmp_path / "0001.ckpt")
+    ckptlib.write_snapshot(prev, _shards(1), _meta(1))
+
+    class Kill(BaseException):
+        pass
+
+    def die_before_manifest(stage):
+        if stage == "manifest":
+            raise Kill()
+
+    cur = str(tmp_path / "0002.ckpt")
+    with pytest.raises(Kill):
+        ckptlib.write_snapshot(cur, _shards(2), _meta(2),
+                               fault_hook=die_before_manifest)
+    assert ckptlib.validate_snapshot(prev) is not None
+    assert ckptlib.validate_snapshot(cur) is None  # no manifest
+    # a partial dir also never shadows the valid one in the scan
+    cands = ckptlib.list_snapshots(str(tmp_path))
+    assert [c for c, _ in cands] == [1, 2]
+
+
+def test_rewrite_drops_manifest_first(tmp_path):
+    """Overwriting a committed snapshot (rollback retry) must not leave
+    a manifest pointing at mixed-age shards: the old manifest goes away
+    before any shard is touched."""
+    path = str(tmp_path / "0003.ckpt")
+    ckptlib.write_snapshot(path, _shards(1), _meta(3))
+
+    class Kill(BaseException):
+        pass
+
+    def die_after_first_shard(stage):
+        if stage.startswith("shard:"):
+            raise Kill()
+
+    with pytest.raises(Kill):
+        ckptlib.write_snapshot(path, _shards(2), _meta(3),
+                               fault_hook=die_after_first_shard)
+    assert ckptlib.validate_snapshot(path) is None
+
+
+def test_prune_retention_and_debris(tmp_path):
+    for i in range(1, 5):
+        ckptlib.write_snapshot(str(tmp_path / f"{i:04d}.ckpt"),
+                               _shards(i), _meta(i))
+    # an uncommitted partial older than the newest commit (kill debris)
+    os.makedirs(tmp_path / "0000.ckpt")
+    removed = ckptlib.prune_snapshots(str(tmp_path), keep=2)
+    assert removed == 3  # 0001, 0002, and the 0000 debris
+    left = sorted(n for n in os.listdir(tmp_path) if n.endswith(".ckpt"))
+    assert left == ["0003.ckpt", "0004.ckpt"]
+    # legacy .model files are never pruned
+    open(tmp_path / "0001.model", "wb").write(b"x")
+    assert ckptlib.prune_snapshots(str(tmp_path), keep=1) == 1
+    assert os.path.exists(tmp_path / "0001.model")
+
+
+# ------------------------------------------------------------ async writer
+
+def test_writer_commits_and_reports(tmp_path):
+    done = []
+    w = AsyncCheckpointWriter(on_done=done.append)
+    w.submit(str(tmp_path / "0001.ckpt"), _shards(), _meta(),
+             counter=1, keep=3)
+    w.close()
+    assert len(done) == 1
+    st = done[0]
+    assert st["counter"] == 1 and st["shards"] == 2
+    assert st["write_sec"] >= 0 and st["pruned"] == 0
+    assert ckptlib.validate_snapshot(str(tmp_path / "0001.ckpt"))
+
+
+def test_writer_failure_latches_and_reraises(tmp_path):
+    class Boom(RuntimeError):
+        pass
+
+    def explode(stage):
+        raise Boom("disk on fire")
+
+    old = ckpt_writer.FAULT_HOOK
+    ckpt_writer.FAULT_HOOK = explode
+    try:
+        w = AsyncCheckpointWriter()
+        w.submit(str(tmp_path / "0001.ckpt"), _shards(), _meta(),
+                 counter=1, keep=3)
+        with pytest.raises(Boom):
+            w.drain()
+        with pytest.raises(Boom):  # latched: every later call re-raises
+            w.submit(str(tmp_path / "0002.ckpt"), _shards(), _meta(),
+                     counter=2, keep=3)
+        with pytest.raises(Boom):
+            w.close()
+    finally:
+        ckpt_writer.FAULT_HOOK = old
+    assert ckptlib.validate_snapshot(str(tmp_path / "0001.ckpt")) is None
+
+
+# ------------------------------------------------ legacy single-file path
+
+def test_legacy_save_is_atomic(tmp_path, monkeypatch):
+    """save_model through a crash mid-np.savez: the original file stays
+    intact and no .tmp debris survives (the utils/serializer.py:80 fix)."""
+    from cxxnet_tpu.utils import serializer
+    path = str(tmp_path / "0001.model")
+    serializer.save_model(path, net_structure={}, epoch=1,
+                          params={"fc": {"wmat": np.ones(3, np.float32)}},
+                          buffers={})
+    header, params, _, _ = serializer.load_model(path)
+    assert header["epoch"] == 1
+
+    class Kill(BaseException):
+        pass
+
+    real_savez = np.savez
+
+    def torn_savez(f, **arrays):
+        f.write(b"PK\x03\x04 torn")
+        raise Kill()
+
+    monkeypatch.setattr(np, "savez", torn_savez)
+    with pytest.raises(Kill):
+        serializer.save_model(
+            path, net_structure={}, epoch=2,
+            params={"fc": {"wmat": np.zeros(3, np.float32)}}, buffers={})
+    monkeypatch.setattr(np, "savez", real_savez)
+    header, params, _, _ = serializer.load_model(path)  # old file intact
+    assert header["epoch"] == 1
+    np.testing.assert_array_equal(params["fc"]["wmat"], np.ones(3))
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+# ----------------------------------------------------- iterator state
+
+def test_iterator_chain_state_roundtrip():
+    from cxxnet_tpu.io.iter_proc import AugmentIterator
+    from cxxnet_tpu.io.data import DataInst
+
+    class _Base(IIterator):
+        def __init__(self):
+            self.i = 0
+
+        def before_first(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 100:
+                return None
+            self.i += 1
+            return DataInst(label=np.zeros(1, np.float32),
+                            data=np.ones((1, 4, 4), np.float32),
+                            index=self.i)
+
+        def state(self):
+            return {"i": self.i}
+
+        def set_state(self, st):
+            self.i = st["i"]
+
+    it = AugmentIterator(_Base())
+    it.set_param("rand_mirror", "1")
+    it.init()
+    it.before_first()
+    for _ in range(7):
+        it.next()
+    st = it.state()
+    # the augment rng is cross-epoch state: advancing past the capture
+    # and then restoring must reproduce the SAME downstream draws
+    a = [bool(it.rnd.rand() < 0.5) for _ in range(20)]
+    it.set_state(st)
+    assert it.base.i == 7
+    b = [bool(it.rnd.rand() < 0.5) for _ in range(20)]
+    assert a == b
+    # json round-trip (the manifest carries it)
+    st2 = json.loads(json.dumps(st))
+    it.set_state(st2)
+    c = [bool(it.rnd.rand() < 0.5) for _ in range(20)]
+    assert a == c
+
+
+def test_membuffer_resume_survives_producer_prepulls():
+    """A threadbuffer stacked over a membuffer primes its producer at
+    init() — BEFORE resume state can be applied — pulling batches
+    through the unfilled cache and advancing the base's cross-epoch rng.
+    set_state must drop those pulls and rewind to the recorded pre-fill
+    state so the rebuilt cache is bitwise the original fill."""
+    import time as _time
+    from cxxnet_tpu.io.iter_proc import (DenseBufferIterator,
+                                         ThreadBufferIterator)
+
+    class _RngBase(IIterator):
+        """Deterministic stream whose values come from a cross-epoch rng
+        (the augment discipline, distilled)."""
+
+        def __init__(self):
+            self.i = 0
+            self.rnd = np.random.RandomState(7)
+
+        def before_first(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 4:
+                return None
+            self.i += 1
+            # value couples the CURSOR and the rng draw (augment batch =
+            # f(item, noise)): a rebuild whose cursor rewound but whose
+            # rng kept advancing pairs the wrong noise with each item
+            return (self.i * 10 + self.rnd.rand(3)).astype(np.float32)
+
+        def state(self):
+            name, keys, pos, g, c = self.rnd.get_state()
+            return {"i": self.i,
+                    "rnd": [name, np.asarray(keys).tolist(), int(pos),
+                            int(g), float(c)]}
+
+        def set_state(self, st):
+            self.i = int(st["i"])
+            name, keys, pos, g, c = st["rnd"]
+            self.rnd.set_state((name, np.asarray(keys, np.uint32),
+                                int(pos), int(g), float(c)))
+
+    def _chain(max_buffer):
+        # the two runs get DIFFERENT buffer depths: the producer primes
+        # a different number of pre-pulls before resume state arrives,
+        # as real thread timing would
+        it = ThreadBufferIterator(DenseBufferIterator(_RngBase()),
+                                  max_buffer=max_buffer)
+        it.set_param("max_nbatch", "4")
+        it.init()
+        return it
+
+    def _epoch(it):
+        it.before_first()
+        out = []
+        while True:
+            b = it.next()
+            if b is None:
+                return out
+            out.append(b)
+
+    a = _chain(2)
+    _epoch(a)          # epoch 1: the fill
+    st = json.loads(json.dumps(a.state()))  # round-boundary snapshot
+    ca = _epoch(a)     # epoch 2: cache replay == the canonical data
+    a.close()
+
+    b = _chain(1)      # resume: init() primed the producer, which has
+    _time.sleep(0.05)  # already pulled batches through the empty cache
+    b.set_state(st)
+    cb = _epoch(b)
+    b.close()
+    assert len(cb) == len(ca) == 4
+    for x, y in zip(ca, cb):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_imgbin_epoch_shuffle_state():
+    """ImageBinIterator's per-epoch shuffle is seeded ``787 + seed_data
+    + gen``: the epoch counter must survive resume or the restarted
+    process replays epoch-1 order for every epoch."""
+    from cxxnet_tpu.io.imbin import ImageBinIterator
+    it = ImageBinIterator.__new__(ImageBinIterator)
+    it._gen, it._thread, it._queue = 6, None, None
+    st = json.loads(json.dumps(it.state()))
+    it2 = ImageBinIterator.__new__(ImageBinIterator)
+    it2._gen, it2._thread = 1, None  # a primed fresh process
+    it2.set_state(st)
+    assert it2._gen == 6  # next before_first seeds epoch 7, as unkilled
+
+
+def test_image_iterator_shuffle_epoch_state():
+    """ImageIterator mutates ``order`` in place with a fixed-seed
+    shuffle each epoch; set_state replays k shuffles instead of storing
+    the permutation."""
+    from cxxnet_tpu.io.imbin import ImageIterator
+
+    def fresh():
+        it = ImageIterator()
+        it.shuffle, it.seed_data = 1, 3
+        it.items = list(range(10))
+        it.order = np.arange(10)
+        it._epochs = 0
+        return it
+
+    a = fresh()
+    for _ in range(4):
+        a.before_first()
+    st = json.loads(json.dumps(a.state()))
+    b = fresh()
+    b.set_state(st)
+    np.testing.assert_array_equal(a.order, b.order)
+    a.before_first()
+    b.before_first()  # and the NEXT epoch's order matches too
+    np.testing.assert_array_equal(a.order, b.order)
+
+
+def test_sentinel_state_roundtrip():
+    from cxxnet_tpu.monitor.metrics import MetricsRegistry
+    from cxxnet_tpu.monitor.sentinel import SentinelBank
+    b1 = SentinelBank(MetricsRegistry(), rel=0.2, warmup=2, ring=8)
+    for i, v in enumerate([100.0, 101.0, 99.0, 100.5]):
+        b1.observe_step({"examples_per_sec": v, "step": i})
+    st = json.loads(json.dumps(b1.state()))
+    b2 = SentinelBank(MetricsRegistry(), rel=0.2, warmup=2, ring=8)
+    b2.set_state(st)
+    s1 = b1.sentinels["examples_per_sec"]
+    s2 = b2.sentinels["examples_per_sec"]
+    assert s2.seen == s1.seen
+    assert abs(s2.ewma.mean - s1.ewma.mean) < 1e-9
+    assert len(b2.ring) == len(b1.ring)
+
+
+# --------------------------------------------------------- CLI end-to-end
+
+MLP_DROPOUT_NET = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+layer[1->2] = relu
+layer[2->2] = dropout
+  threshold = 0.5
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig=end
+"""
+
+
+def _write_synth_mnist(tmp_path, n=128, classes=4, side=12):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import make_synth_mnist as sm
+    rnd = np.random.RandomState(0)
+    labels = rnd.randint(0, classes, n)
+    imgs = np.stack([
+        np.clip(sm.class_pattern(l, side, side) * 255
+                + rnd.rand(side, side) * 32, 0, 255)
+        for l in labels])
+    sm.write_idx_images(str(tmp_path / "img.gz"), imgs)
+    sm.write_idx_labels(str(tmp_path / "lbl.gz"), labels)
+
+
+def _write_conf(tmp_path, model_dir, extra=""):
+    conf = tmp_path / f"{os.path.basename(model_dir)}.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 1
+iter = end
+{MLP_DROPOUT_NET}
+input_shape = 1,1,144
+batch_size = 16
+eta = 0.05
+momentum = 0.9
+num_round = 6
+model_dir = {model_dir}
+save_model = 1
+ckpt_async = 1
+silent = 1
+{extra}
+""")
+    return conf
+
+
+def _make_task(conf, *args):
+    task = LearnTask()
+    for k, v in parse_config_file(str(conf)):
+        task.set_param(k, v)
+    for k, v in parse_keyval_args(list(args)):
+        task.set_param(k, v)
+    task._conf_path = str(conf)
+    return task
+
+
+def _run_task(task):
+    try:
+        task.init()
+        task.task_train()
+    finally:
+        for it in ([task.itr_train] if task.itr_train else []) \
+                + task.itr_evals:
+            it.close()
+        if task.net is not None:
+            task.net.metrics.close()
+
+
+def _snapshot_arrays(path):
+    manifest, shards = ckptlib.load_snapshot(path)
+    flat = {}
+    for name, arrays in sorted(shards.items()):
+        for k, v in arrays.items():
+            flat[f"{name}:{k}"] = v
+    return manifest, flat
+
+
+class _KillAtBatch(IIterator):
+    """Raises mid-round after ``at`` batches — the process-kill stand-in
+    (everything after the last committed snapshot is lost either way)."""
+
+    class Killed(Exception):
+        pass
+
+    def __init__(self, base, at):
+        self.base = base
+        self.at = at
+        self.count = 0
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self):
+        if self.count >= self.at:
+            raise self.Killed(f"injected kill at batch {self.count}")
+        b = self.base.next()
+        if b is not None:
+            self.count += 1
+        return b
+
+
+@pytest.mark.slow
+def test_kill_resume_trajectory_bitwise(tmp_path):
+    """The tentpole acceptance: train 6 rounds (run A); train the same
+    config killed MID-ROUND-5 and resume with continue=1 (run B).  The
+    final snapshots must agree bitwise — params, opt state, buffers,
+    rng stream, sample counter — at f32 on CPU.  Dropout makes the rng
+    stream load-bearing; momentum makes the opt state load-bearing;
+    the per-round snapshots exercise the async writer + retention."""
+    _write_synth_mnist(tmp_path)
+    conf_a = _write_conf(tmp_path, str(tmp_path / "A"))
+    _run_task(_make_task(conf_a))
+    # run B: identical, killed during round 5 (after snapshot 0004)
+    conf_b = _write_conf(tmp_path, str(tmp_path / "B"))
+    task_b = _make_task(conf_b)
+    task_b.init()
+    task_b.itr_train = _KillAtBatch(task_b.itr_train, at=4 * 8 + 3)
+    with pytest.raises(_KillAtBatch.Killed):
+        try:
+            task_b.task_train()
+        finally:
+            task_b.net.metrics.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "B" / "0004.ckpt"))
+    # resume: a FRESH process image (new LearnTask) continues to 6
+    _run_task(_make_task(conf_b, "continue=1"))
+
+    ma, fa = _snapshot_arrays(str(tmp_path / "A" / "0006.ckpt"))
+    mb, fb = _snapshot_arrays(str(tmp_path / "B" / "0006.ckpt"))
+    assert fa.keys() == fb.keys()
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+    tsa = ma["extra"]["train_state"]
+    tsb = mb["extra"]["train_state"]
+    assert tsa["sample_counter"] == tsb["sample_counter"] == 48
+    assert tsa["rng_key"] == tsb["rng_key"]
+    assert ma["extra"]["iter_state"] == mb["extra"]["iter_state"]
+    # retention: ckpt_keep=3 pruned the early snapshots in both runs
+    for d in ("A", "B"):
+        kept = sorted(n for n in os.listdir(tmp_path / d)
+                      if n.endswith(".ckpt"))
+        assert kept == ["0004.ckpt", "0005.ckpt", "0006.ckpt"]
+
+
+def test_continue_skips_partial_snapshot(tmp_path):
+    """continue=1 with a corrupted NEWEST snapshot resumes from the
+    previous one (the scan skips, warns, and the next save overwrites
+    the debris)."""
+    _write_synth_mnist(tmp_path)
+    conf = _write_conf(tmp_path, str(tmp_path / "C"), extra="num_round = 3")
+    _run_task(_make_task(conf))
+    # corrupt the newest (0003) the way a kill does: no manifest
+    os.remove(tmp_path / "C" / "0003.ckpt" / ckptlib.MANIFEST)
+    task = _make_task(conf, "continue=1", "num_round=4")
+    task.init()
+    assert task.start_counter == 3  # resumed from 0002, not the debris
+    try:
+        task.task_train()
+    finally:
+        task.net.metrics.close()
+        for it in [task.itr_train] + task.itr_evals:
+            it.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "C" / "0004.ckpt"))
+    # the debris round was re-saved and committed on the way through
+    assert ckptlib.validate_snapshot(str(tmp_path / "C" / "0003.ckpt"))
+
+
+def test_continue_skips_nonfinite_snapshot(tmp_path):
+    """A rollback that walked past a NaN-poisoned snapshot leaves it on
+    disk (crc-valid, loadable): a later continue=1 must apply the same
+    finite-params gate and resume from the older good one."""
+    _write_synth_mnist(tmp_path)
+    conf = _write_conf(tmp_path, str(tmp_path / "P"), extra="num_round = 3")
+    _run_task(_make_task(conf))
+    # poison the NEWEST snapshot the way a diverged-then-saved round
+    # does: params all-NaN, manifest recommitted (checksums valid)
+    path = str(tmp_path / "P" / "0003.ckpt")
+    manifest, shards = ckptlib.load_snapshot(path)
+    for k in shards["params"]:
+        shards["params"][k] = np.full_like(shards["params"][k], np.nan)
+    meta = {k: manifest[k] for k in
+            ("net", "epoch", "has_opt_state", "dtypes", "extra")}
+    ckptlib.write_snapshot(path, shards, meta)
+    assert ckptlib.validate_snapshot(path) is not None  # loadable...
+    task = _make_task(conf, "continue=1")
+    task.init()
+    try:
+        assert task.start_counter == 3  # ...but resumed from 0002
+        assert all(np.isfinite(np.asarray(leaf)).all()
+                   for leaf in __import__("jax").tree.leaves(
+                       task.net.params))
+    finally:
+        task.net.metrics.close()
+        for it in [task.itr_train] + task.itr_evals:
+            it.close()
+
+
+def test_writer_fault_fails_the_run_then_resume(tmp_path):
+    """A writer failure latches and re-raises IN the train loop (never a
+    silent no-more-snapshots run); after the fault clears, continue=1
+    resumes from the last committed snapshot, skipping the partial."""
+    _write_synth_mnist(tmp_path)
+    conf = _write_conf(tmp_path, str(tmp_path / "F"))
+
+    class Boom(RuntimeError):
+        pass
+
+    manifests = [0]
+
+    def die_on_third_manifest(stage):
+        if stage == "manifest":
+            manifests[0] += 1
+            if manifests[0] == 3:  # 0000, 0001 commit; 0002 dies
+                raise Boom("injected writer fault")
+
+    old = ckpt_writer.FAULT_HOOK
+    ckpt_writer.FAULT_HOOK = die_on_third_manifest
+    try:
+        with pytest.raises(Boom):
+            _run_task(_make_task(conf))
+    finally:
+        ckpt_writer.FAULT_HOOK = old
+    assert ckptlib.validate_snapshot(str(tmp_path / "F" / "0001.ckpt"))
+    assert ckptlib.validate_snapshot(
+        str(tmp_path / "F" / "0002.ckpt")) is None
+    task = _make_task(conf, "continue=1", "num_round=3")
+    task.init()
+    assert task.start_counter == 2
+    try:
+        task.task_train()
+    finally:
+        task.net.metrics.close()
+        for it in [task.itr_train] + task.itr_evals:
+            it.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "F" / "0003.ckpt"))
+
+
+def test_reshard_restore_data2_to_1_and_back(tmp_path):
+    """A snapshot saved on a data:2 mesh restores onto 1 device (and
+    vice versa): the host shards are logical arrays, load_model reshards
+    through the current NamedShardings.  The restore itself is bitwise;
+    training then proceeds on the new mesh."""
+    import jax
+    _write_synth_mnist(tmp_path)
+    conf2 = _write_conf(tmp_path, str(tmp_path / "M2"),
+                        extra="num_round = 2")
+    _run_task(_make_task(conf2, "dev=cpu:0-1"))
+    _, saved = _snapshot_arrays(str(tmp_path / "M2" / "0002.ckpt"))
+    # restore onto ONE device and keep training
+    task = _make_task(conf2, "continue=1", "dev=cpu", "num_round=3")
+    task.init()
+    assert task.net.mesh.devices.size == 1
+    for k, v in saved.items():
+        if not k.startswith("params:params/"):
+            continue
+        parts = k.split("/")[1:]
+        leaf = task.net.params
+        for p in parts:
+            leaf = leaf[p]
+        np.testing.assert_array_equal(np.asarray(leaf), v, err_msg=k)
+    try:
+        task.task_train()
+    finally:
+        task.net.metrics.close()
+        for it in [task.itr_train] + task.itr_evals:
+            it.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "M2" / "0003.ckpt"))
+    # and the other direction: 1-device save -> data:2 restore
+    conf1 = _write_conf(tmp_path, str(tmp_path / "M1"),
+                        extra="num_round = 2")
+    _run_task(_make_task(conf1))
+    task = _make_task(conf1, "continue=1", "dev=cpu:0-1", "num_round=3")
+    task.init()
+    assert task.net.mesh.devices.size == 2
+    try:
+        task.task_train()
+    finally:
+        task.net.metrics.close()
+        for it in [task.itr_train] + task.itr_evals:
+            it.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "M1" / "0003.ckpt"))
+
+
+class _PoisonOnce(IIterator):
+    """NaN-poisons one batch, once — the divergence injection."""
+
+    def __init__(self, base, at):
+        self.base = base
+        self.at = at
+        self.count = 0
+        self.fired = False
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self):
+        b = self.base.next()
+        if b is None:
+            return None
+        self.count += 1
+        if not self.fired and self.count == self.at:
+            self.fired = True
+            import dataclasses
+            b = dataclasses.replace(
+                b, data=np.full_like(b.data, np.nan))
+        return b
+
+
+@pytest.mark.slow
+def test_rollback_recovers_from_nan_poison(tmp_path):
+    """monitor_nan=fatal raises TrainingDiverged on the poisoned batch;
+    rollback=2 restores the last good snapshot, reseeds the rng, and the
+    retried run (poison is one-shot) completes all rounds.  The sink
+    carries the rollback record and the final snapshot is committed."""
+    _write_synth_mnist(tmp_path)
+    sink = tmp_path / "m.jsonl"
+    conf = _write_conf(
+        tmp_path, str(tmp_path / "R"),
+        extra=f"""num_round = 5
+monitor = 1
+monitor_interval = 1
+monitor_nan = fatal
+rollback = 2
+metrics_sink = jsonl:{sink}
+""")
+    task = _make_task(conf)
+    task.init()
+    task.itr_train = _PoisonOnce(task.itr_train, at=2 * 8 + 3)  # round 3
+    try:
+        task.task_train()
+    finally:
+        task.net.metrics.close()
+        task.itr_train.close()
+        for it in task.itr_evals:
+            it.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "R" / "0005.ckpt"))
+    recs = [json.loads(l) for l in open(sink) if l.strip()]
+    kinds = {}
+    for r in recs:
+        kinds.setdefault(r["kind"], []).append(r)
+    assert len(kinds.get("rollback", [])) == 1
+    rb = kinds["rollback"][0]
+    assert rb["retry"] == 1 and rb["restored_round"] == 2
+    assert "TrainingDiverged" in rb["reason"]
+    assert kinds.get("nan"), "the nan record should precede the rollback"
+    assert kinds.get("ckpt"), "ckpt records should be in the stream"
+    # rollback exhaustion still re-raises: poison EVERY pass, rollback=1
+    conf2 = _write_conf(
+        tmp_path, str(tmp_path / "R2"),
+        extra="""num_round = 4
+monitor = 1
+monitor_interval = 1
+monitor_nan = fatal
+rollback = 1
+""")
+    task2 = _make_task(conf2)
+    task2.init()
+
+    class _PoisonAlways(_PoisonOnce):
+        def next(self):
+            b = self.base.next()
+            if b is None:
+                return None
+            self.count += 1
+            if self.count % (2 * 8 + 3) == 0:
+                import dataclasses
+                b = dataclasses.replace(
+                    b, data=np.full_like(b.data, np.nan))
+            return b
+
+    task2.itr_train = _PoisonAlways(task2.itr_train, at=0)
+    with pytest.raises(TrainingDiverged):
+        try:
+            task2.task_train()
+        finally:
+            task2.net.metrics.close()
+            task2.itr_train.close()
+            for it in task2.itr_evals:
+                it.close()
+
+
+# --------------------------------------------------------- lint rules
+
+def test_ckpt_lint_rules():
+    from cxxnet_tpu.analysis.conflint import lint_pairs
+
+    def msgs(pairs, sev=None):
+        return [f for f in lint_pairs(pairs)
+                if f.key in ("rollback", "ckpt_keep", "ckpt_async",
+                             "save_opt", "ckpt_iter_state")
+                and (sev is None or f.severity == sev)]
+
+    # rollback without the fatal NaN guard: warned
+    f = msgs([("task", "train"), ("rollback", "2"),
+              ("model_dir", "/tmp/m")])
+    assert any("monitor_nan = fatal" in x.message for x in f)
+    # properly configured: no rollback findings
+    f = msgs([("task", "train"), ("rollback", "2"), ("monitor", "1"),
+              ("monitor_nan", "fatal"), ("model_dir", "/tmp/m"),
+              ("ckpt_async", "1"), ("ckpt_keep", "3")])
+    assert not f, [x.format() for x in f]
+    # save_model=0 defeats rollback: error
+    f = msgs([("task", "train"), ("rollback", "1"), ("monitor", "1"),
+              ("monitor_nan", "fatal"), ("model_dir", "/tmp/m"),
+              ("save_model", "0")], sev="error")
+    assert f and "save_model = 0" in f[0].message
+    # ckpt_keep=1 with rollback: no fallback snapshot
+    f = msgs([("task", "train"), ("rollback", "1"), ("monitor", "1"),
+              ("monitor_nan", "fatal"), ("model_dir", "/tmp/m"),
+              ("ckpt_async", "1"), ("ckpt_keep", "1")])
+    assert any("ckpt_keep = 1" in x.message for x in f)
+    # retention without async snapshots: warned
+    f = msgs([("task", "train"), ("ckpt_keep", "5")])
+    assert any(".ckpt" in x.message for x in f)
+    # ckpt keys off-task: warned
+    f = msgs([("task", "pred"), ("ckpt_async", "1")])
+    assert any("task = train" in x.message for x in f)
+    # unknown-key detection still catches typos of the new keys
+    f = [x for x in lint_pairs([("task", "train"), ("ckpt_asynk", "1")])
+         if x.key == "ckpt_asynk"]
+    assert f and f[0].severity == "error" \
+        and f[0].suggestion == "ckpt_async"

@@ -131,6 +131,28 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
             {k: r.get(k) for k in
              ("op", "count", "mean", "p50", "p95", "p99", "max", "unit")
              if k in r} for r in by["latency"]]
+    ckpts = by.get("ckpt", [])
+    if ckpts:
+        n_async = sum(1 for r in ckpts if r.get("async_write"))
+        rep["checkpoints"] = {
+            "saves": len(ckpts),
+            "async": n_async,
+            "bytes_last": ckpts[-1].get("bytes"),
+            "bytes_total": sum(r.get("bytes") or 0 for r in ckpts),
+            # off-thread write wall vs what the train loop actually paid
+            # (host pull + backpressure block) — the async win is the gap
+            "write_sec": round(sum(r.get("write_sec") or 0.0
+                                   for r in ckpts), 3),
+            "blocked_sec": round(sum(r.get("blocked_sec") or 0.0
+                                     for r in ckpts), 3),
+            "pruned": sum(r.get("pruned") or 0 for r in ckpts),
+            "last_round": ckpts[-1].get("round"),
+        }
+    if by.get("rollback"):
+        rep["rollbacks"] = [
+            {k: r.get(k) for k in
+             ("retry", "max_retry", "from_round", "restored_round",
+              "path", "reason") if k in r} for r in by["rollback"]]
     if by.get("anomaly"):
         rep["anomalies"] = [
             {k: r.get(k) for k in
@@ -260,6 +282,25 @@ def render(rep: dict) -> str:
               _fmt(r.get("mean")), _fmt(r.get("p50")),
               _fmt(r.get("p95")), _fmt(r.get("p99")),
               _fmt(r.get("max"))] for r in lat]))
+    ck = rep.get("checkpoints")
+    if ck:
+        out.append("")
+        out.append(
+            f"checkpoints: {ck['saves']} save(s) "
+            f"({ck['async']} async), last {_fmt(ck['bytes_last'])} bytes "
+            f"at round {_fmt(ck['last_round'])}; write "
+            f"{_fmt(ck['write_sec'])} s off-thread, loop blocked "
+            f"{_fmt(ck['blocked_sec'])} s"
+            + (f"; pruned {ck['pruned']}" if ck.get("pruned") else ""))
+    rbs = rep.get("rollbacks")
+    if rbs:
+        out.append("")
+        out.append(f"ROLLBACKS: {len(rbs)}")
+        out.append(_table(
+            ["retry", "from", "restored", "reason"],
+            [[_fmt(r.get("retry")), _fmt(r.get("from_round")),
+              _fmt(r.get("restored_round")),
+              str(r.get("reason", "?"))[:60]] for r in rbs]))
     anoms = rep.get("anomalies")
     if anoms:
         out.append("")
