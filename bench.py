@@ -938,6 +938,192 @@ def _dp_model_table(args, dev, tiny):
     return model_spec, [1, 2, 4, 8]
 
 
+def _lm_chain(shard_pattern, n_shards, seqlen, batch, pack_split=1):
+    """text + packseq iterator chain over packed shards."""
+    from cxxnet_tpu.io.text import PackedSeqIterator, TextIterator
+    it = TextIterator()
+    it.set_param("path_tok", shard_pattern)
+    it.set_param("tok_count", str(n_shards))
+    it.set_param("shuffle", "1")
+    it.set_param("silent", "1")
+    p = PackedSeqIterator(it)
+    p.set_param("seqlen", str(seqlen))
+    p.set_param("batch_size", str(batch))
+    p.set_param("pack_split", str(pack_split))
+    p.init()
+    return p
+
+
+def _lm_nosplit_efficiency(shard_pattern, n_shards, seqlen, batch) -> float:
+    """Host-only pass of the whole-document packer over the same shards:
+    the padding fraction the split packer avoids."""
+    p = _lm_chain(shard_pattern, n_shards, seqlen, batch, pack_split=0)
+    p.before_first()
+    while p.next() is not None:
+        pass
+    p.close()
+    return p.stats()["packing_efficiency"]
+
+
+def bench_lm(argv=None) -> dict:
+    """``--lm``: tokenized-LM data-path bench over the two flagship
+    sequence workloads (example/LM/*.conf shapes) — a long-context
+    transformer on ``data:2,seq:2`` and a switch-MoE LM on
+    ``data:2,expert:2``.  Generates a synthetic learnable corpus
+    (tools/make_synth_text.py), packs it into token shards
+    (io/text.py), trains ``steps`` real update dispatches through the
+    text+packseq chain, and reports per model: tokens/sec (total and
+    per chip), **packing efficiency** (real-token fraction; 1.0 for the
+    stream-chop packer, plus the whole-document packer's number on the
+    same corpus for comparison), and the trace-attributed **per-axis
+    comm shares** (collective-permute → seq, all-to-all → expert; zero
+    with ``comm_attributed: false`` on CPU-runtime traces).
+
+    ``key=value`` overrides: ``dev`` (default cpu), ``models``
+    (longctx,moe), ``steps``, ``batch``, ``seqlen``, ``vocab``,
+    ``docs``; ``--tiny``/``tiny=1`` shrinks everything for CI smoke."""
+    import os
+    import shutil
+    import tempfile
+
+    args = dict(a.split("=", 1) for a in (argv or []) if "=" in a)
+    tiny = args.get("tiny") == "1" or "--tiny" in (argv or [])
+    dev = args.get("dev", "cpu")
+    models = [m for m in args.get("models", "longctx,moe").split(",") if m]
+    n_dev = 4
+    if dev == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if dev == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    assert len(jax.devices()) >= n_dev, (
+        f"--lm needs {n_dev} devices; {len(jax.devices())} visible")
+    from cxxnet_tpu.models import transformer
+    from cxxnet_tpu.monitor.trace import comm_report
+    from __graft_entry__ import _make_trainer
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from make_synth_text import gen_docs
+    from cxxnet_tpu.io.text import write_token_shard
+
+    if tiny:
+        vocab, seqlen, dim, nlayer, nhead = 64, 32, 32, 1, 2
+        batch, steps, n_docs, mean_len = 4, 4, 200, 24
+    else:
+        vocab, seqlen, dim, nlayer, nhead = 512, 256, 64, 2, 4
+        batch, steps, n_docs, mean_len = 8, 24, 2000, 96
+    vocab = int(args.get("vocab", vocab))
+    seqlen = int(args.get("seqlen", seqlen))
+    batch = int(args.get("batch", batch))
+    steps = int(args.get("steps", steps))
+    n_docs = int(args.get("docs", n_docs))
+
+    spec = {
+        "longctx": ("data:2,seq:2", dict()),
+        "moe": ("data:2,expert:2", dict(moe_experts=4)),
+    }
+    tmp = tempfile.mkdtemp(prefix="bench_lm_")
+    out_models = {}
+    try:
+        docs = gen_docs(n_docs, vocab=vocab, mean_len=mean_len, seed=0)
+        n_shards = 4
+        pattern = os.path.join(tmp, "c_%d.tok")
+        for s in range(n_shards):
+            write_token_shard(pattern % s, docs[s::n_shards],
+                              itemsize=2 if vocab <= 65536 else 4)
+        eff_nosplit = _lm_nosplit_efficiency(pattern, n_shards, seqlen,
+                                             batch)
+        for name in models:
+            assert name in spec, f"--lm: unknown model {name!r}"
+            mesh, extra = spec[name]
+            # the moe LM needs seqlen % seq axis only for longctx; both
+            # meshes are 4 devices
+            t = _make_trainer(
+                transformer(vocab=vocab, seq=seqlen, dim=dim,
+                            nlayer=nlayer, nhead=nhead, packed=True,
+                            **extra),
+                batch, f"{dev}:0-{n_dev - 1}",
+                extra=[("mesh", mesh), ("updater", "adam"),
+                       ("eta", "0.001"), ("eval_train", "0"),
+                       ("silent", "1")])
+            chain = _lm_chain(pattern, n_shards, seqlen, batch)
+            t.start_round(1)
+
+            def batches():
+                while True:
+                    chain.before_first()
+                    while True:
+                        b = chain.next()
+                        if b is None:
+                            break
+                        yield b
+
+            gen = batches()
+            t.update(next(gen))  # warmup / compile
+            np.asarray(t._last_loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                t.update(next(gen))
+            np.asarray(t._last_loss)
+            wall = time.perf_counter() - t0
+            tok_s = steps * batch * seqlen / wall
+            point = {
+                "mesh": mesh, "steps": steps,
+                "tokens_per_sec": round(tok_s, 1),
+                "tokens_per_sec_per_chip": round(tok_s / n_dev, 1),
+                "packing_efficiency": chain.stats()["packing_efficiency"],
+                "packing_efficiency_nosplit": eff_nosplit,
+                "loss": round(float(np.asarray(t._last_loss)), 4),
+            }
+            tdir = os.path.join(tmp, f"prof_{name}")
+            try:
+                jax.profiler.start_trace(tdir)
+                try:
+                    t.update(next(gen))
+                    np.asarray(t._last_loss)
+                finally:
+                    jax.profiler.stop_trace()
+                rep = comm_report(tdir, steps=1)
+                point.update(
+                    comm_share=rep["comm_share"],
+                    overlap_frac=rep["overlap_frac"],
+                    comm_share_per_axis=_comm_axis_shares(rep),
+                    comm_attributed=bool(rep["comm_sec"]
+                                         or rep["device_sec"]))
+            except Exception as e:  # tracing must never break the metric
+                print(f"bench: lm trace failed ({name}): {e}",
+                      file=sys.stderr)
+                point.update(comm_share=0.0, overlap_frac=0.0,
+                             comm_share_per_axis={},
+                             comm_attributed=False)
+            chain.close()
+            out_models[name] = point
+            print(f"bench: lm {name} {mesh} {point['tokens_per_sec']:.0f} "
+                  f"tok/s (pack eff {point['packing_efficiency']:.2f} vs "
+                  f"{eff_nosplit:.2f} nosplit), comm/axis "
+                  f"{point['comm_share_per_axis']}", file=sys.stderr)
+            del t
+            import gc
+            gc.collect()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    head = out_models[models[0]]
+    return {
+        "metric": "lm_tokens_per_sec",
+        "value": head["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "packing_efficiency": head["packing_efficiency"],
+        "comm_share_per_axis": head["comm_share_per_axis"],
+        "models": out_models,
+    }
+
+
 OPT_AB_ARMS = {
     # arm -> engine/config pairs on top of the flagship transformer
     # (the owed BENCH_r06 session: fused_update and pallas_ln A/Bs,
@@ -1050,6 +1236,7 @@ BENCH_MODES = {
     "--dp-scaling": bench_dp_scaling,
     "--io-ab": bench_io_ab,
     "--serve": bench_serve,
+    "--lm": bench_lm,
 }
 
 

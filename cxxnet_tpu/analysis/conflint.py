@@ -364,6 +364,7 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                         "task = extract requires extract_node_name"))
     _serve_rules(last, task, add)
     _ckpt_rules(last, task, monitor, add)
+    _text_rules(pairs, last, layer_types, add)
 
 
 def _ckpt_rules(last: Dict[str, str], task: str, monitor: int, add) -> None:
@@ -456,6 +457,144 @@ def _serve_rules(last: Dict[str, str], task: str, add) -> None:
                             f"bucket ({max(buckets)}); coalescing caps at "
                             "the bucket and larger requests split across "
                             "dispatches"))
+
+
+#: layer types that consume/produce (b, 1, s, d) sequence nodes — the
+#: set the seq-mesh-axis rule checks for
+_SEQ_LAYER_TYPES = ("attention", "embedding", "seq_fullc", "softmax_seq",
+                    "moe")
+
+
+def _text_rules(pairs: ConfigPairs, last: Dict[str, str],
+                layer_types: List[str], add) -> None:
+    """Cross-key rules for the tokenized text / packed-LM path
+    (io/text.py, doc/io.md "Tokenized text datasets"):
+
+    * a ``seq`` mesh axis with no sequence layer in the net warns (the
+      axis shards nothing — devices replicate work);
+    * the sequence length must divide by the ``seq`` axis, or attention
+      falls back to dense with a full-sequence gather (runtime warns;
+      surfaced here before any compile);
+    * a ``packseq`` data section requires segment-aware consumers:
+      ``softmax_seq`` without ``packed = 1`` trains on cross-document
+      targets and ``attention`` without ``segment_key`` leaks
+      cross-document scores — both errors;
+    * the packer's ``seqlen`` must equal the netconfig input width.
+    """
+    from ..parallel.mesh import MeshSpec
+    seq_ax = 1
+    mesh_str = last.get("mesh", "")
+    if mesh_str:
+        try:
+            seq_ax = MeshSpec.parse(mesh_str).axes.get("seq", 1)
+        except ValueError:
+            seq_ax = 1  # unparsable mesh: its own KeySpec's problem
+
+    # scan sections for packseq chains + their seqlen; track the layer
+    # keys that make packing safe (the same positional walk lint_pairs
+    # does — sections must be skipped before layer keys are attributed)
+    flag = 0
+    pack_sections = []  # (section kind flag, seqlen value or None)
+    cur_chain: List[str] = []
+    cur_seqlen: Optional[str] = None
+    # a seqlen OUTSIDE any section (file-global or CLI override) is
+    # applied to the chain LAST by init_iterator's defcfg pass, so it
+    # overrides every section's value — the lint must check the value
+    # the runtime will actually use
+    global_seqlen: Optional[str] = None
+    cur_layer = ""
+    n_attention = 0
+    n_att_seg = 0
+    softmax_seq_packed = False
+    for name, val in pairs:
+        if name in _SECTION_HEADS:
+            flag = _SECTION_HEADS[name]
+            cur_chain, cur_seqlen = [], None
+            continue
+        if flag:
+            if name == "iter":
+                if val == "end":
+                    if "packseq" in cur_chain:
+                        pack_sections.append(cur_seqlen)
+                    flag = 0
+                else:
+                    cur_chain.append(val)
+            elif name == "seqlen":
+                cur_seqlen = val
+            continue
+        if name == "seqlen":
+            global_seqlen = val
+            continue
+        if name.startswith("layer["):
+            cur_layer = val.split(":", 1)[0]
+            if cur_layer == "attention":
+                n_attention += 1
+            continue
+        if cur_layer == "attention" and name == "segment_key" and val:
+            n_att_seg += 1
+        elif cur_layer == "softmax_seq" and name == "packed" \
+                and val.strip() == "1":
+            softmax_seq_packed = True
+    if global_seqlen is not None:
+        pack_sections = [global_seqlen for _ in pack_sections]
+
+    has_seq_layer = any(t in _SEQ_LAYER_TYPES for t in layer_types)
+    if seq_ax > 1 and layer_types and not has_seq_layer:
+        add(Finding("warn", "mesh",
+                    f"mesh = {mesh_str} carries a seq axis but the net "
+                    "has no sequence layer (attention/embedding/"
+                    "seq_fullc): the axis shards nothing and its devices "
+                    "replicate work"))
+    # sequence length divisibility: the packer's seqlen and the
+    # netconfig input width both shard over the seq axis
+    in_shape = last.get("input_shape", "")
+    in_width = None
+    if in_shape:
+        try:
+            in_width = int(in_shape.split(",")[-1])
+        except ValueError:
+            pass  # malformed input_shape: NetConfig's structural error
+    seqlens = []  # one entry PER packseq section — a mismatch in any
+    for sl in pack_sections:  # section must surface, not just the last
+        if sl is not None:
+            try:
+                seqlens.append(int(sl))
+            except ValueError:
+                pass  # type error already reported by the KeySpec
+    if seq_ax > 1 and has_seq_layer:
+        for key, w in ([("input_shape", in_width)]
+                       if in_width is not None else []) \
+                + [("seqlen", w) for w in seqlens]:
+            if w % seq_ax:
+                add(Finding("warn", key,
+                            f"sequence length {w} is not divisible by "
+                            f"the seq mesh axis ({seq_ax}); attention "
+                            "falls back to dense and gathers the full "
+                            "sequence on one device"))
+                break
+    if not pack_sections or not layer_types:
+        return
+    if in_width is not None:
+        for w in seqlens:
+            if w != in_width:
+                add(Finding("error", "seqlen",
+                            f"packseq seqlen = {w} but the netconfig "
+                            f"input width is {in_width}; the packed "
+                            "rows will not fit the input node"))
+                break
+    if not softmax_seq_packed and "softmax_seq" in layer_types:
+        add(Finding("error", "packed",
+                    "packseq data section but softmax_seq has no "
+                    "'packed = 1': cross-document and padding targets "
+                    "would train as real next-token targets; set "
+                    "packed = 1 on the loss layer (doc/io.md)"))
+    if n_attention and n_att_seg < n_attention:
+        add(Finding("error", "segment_key",
+                    f"packseq data section but {n_attention - n_att_seg} "
+                    f"of {n_attention} attention layer(s) have no "
+                    "segment_key: cross-document attention leaks across "
+                    "packed rows; set segment_key = <segment field> "
+                    "(doc/io.md)"))
 
 
 def _mesh_rules(last: Dict[str, str], layer_types: List[str],

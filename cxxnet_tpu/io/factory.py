@@ -1,8 +1,10 @@
 """Iterator chain factory (reference ``src/io/data.cpp:23-74``).
 
-``iter = mnist|img|imgbin`` create base iterators (img/imgbin are wrapped
-``BatchAdapt(Augment(base))`` exactly like the reference); ``iter =
-threadbuffer|membuffer|attachtxt`` stack on top.  All config keys seen so
+``iter = mnist|img|imgbin|text`` create base iterators (img/imgbin are
+wrapped ``BatchAdapt(Augment(base))`` exactly like the reference; ``text``
+yields token-shard documents, io/text.py); ``iter =
+threadbuffer|membuffer|attachtxt|packseq`` stack on top (``packseq`` packs
+documents into fixed (batch, seqlen) LM rows).  All config keys seen so
 far in the section are forwarded to every stage (reference: SetParam on the
 whole chain).
 """
@@ -17,6 +19,7 @@ from .iter_mnist import MNISTIterator
 from .iter_proc import (AttachTxtIterator, AugmentIterator,
                         BatchAdaptIterator, DenseBufferIterator,
                         ThreadBufferIterator)
+from .text import PackedSeqIterator, TextIterator
 
 #: ``iter = <name>`` -> the python stage classes that name instantiates,
 #: in wrap order.  The lint registry (analysis/registry.py) harvests each
@@ -32,6 +35,8 @@ ITER_STAGES = {
     "threadbuffer": (ThreadBufferIterator,),
     "membuffer": (DenseBufferIterator,),
     "attachtxt": (AttachTxtIterator,),
+    "text": (TextIterator,),
+    "packseq": (PackedSeqIterator,),
 }
 
 
@@ -72,6 +77,12 @@ def create_iterator(cfg: List[Tuple[str, str]]) -> IIterator:
             elif val == "img":
                 assert it is None, "img cannot chain over another iterator"
                 it = BatchAdaptIterator(AugmentIterator(ImageIterator()))
+            elif val == "text":
+                assert it is None, "text cannot chain over another iterator"
+                it = TextIterator()
+            elif val == "packseq":
+                assert it is not None, "must specify input of packseq"
+                it = PackedSeqIterator(it)
             elif val == "threadbuffer":
                 assert it is not None, "must specify input of threadbuffer"
                 it = ThreadBufferIterator(it)

@@ -39,19 +39,35 @@ def _seq_mesh(ctx: ForwardContext):
     return None
 
 
-def _single_device_attention(q, k, v, causal: bool):
+def _label_field(ctx: ForwardContext, name: str):
+    """A (b, s) label field by name, or None when the key is unset or the
+    forward carries no labels (eval/pred forwards pass label_vec=None —
+    packing-aware layers then fall back to their unpacked behavior)."""
+    if not name or ctx.labels is None or name not in ctx.labels.fields:
+        return None
+    return ctx.labels.fields[name]
+
+
+def _single_device_attention(q, k, v, causal: bool, seg=None):
     """Single-device attention dispatch: the Pallas flash kernel on TPU
     (VMEM-resident scores; measured 3.2x the XLA chunked path forward at
     s=8192 on v5e, and the only path whose backward fits at that length),
     XLA dense/chunked otherwise.  Config key ``flash_attn = 0`` (or env
-    CXXNET_NO_FLASH_ATTN=1) opts out."""
+    CXXNET_NO_FLASH_ATTN=1) opts out.  ``seg`` (b, s) segment ids select
+    the segment-masked variants (packed documents): the triangular-flash
+    segment kernel where the grid allows, the lax fallback elsewhere —
+    the two are pairtested in interpret mode (tests/test_text.py)."""
     from ..engine import opts
     from ..ops import pallas_kernels as pk
     s, hd = q.shape[2], q.shape[3]
     if (pk._on_tpu() and pk.flash_attention_available(s, hd)
             and opts.flash_attn == "1"):
-        return pk.flash_attention(q, k, v, causal)
-    return ring.dense_attention(q, k, v, causal=causal)
+        if seg is not None:
+            if causal:
+                return pk.flash_attention_segmented(q, k, v, seg)
+        else:
+            return pk.flash_attention(q, k, v, causal)
+    return ring.dense_attention(q, k, v, causal=causal, seg=seg)
 
 
 def seq_constraint(x: jnp.ndarray, ctx: ForwardContext) -> jnp.ndarray:
@@ -76,18 +92,25 @@ class EmbeddingLayer(Layer):
     extra_config_keys = (
         K("vocab_size", "int", lo=1),
         K("pos_embed", "int", lo=0, hi=1),
+        K("pos_key", "str",
+          help="label field carrying per-position ids (packed documents "
+               "reset positions at each doc start — io/text.py); empty = "
+               "sequential 0..s-1"),
     )
 
     def __init__(self):
         super().__init__()
         self.vocab_size = 0
         self.pos_embed = 0
+        self.pos_key = ""
 
     def set_param(self, name, val):
         if name == "vocab_size":
             self.vocab_size = int(val)
         elif name == "pos_embed":
             self.pos_embed = int(val)
+        elif name == "pos_key":
+            self.pos_key = val
         else:
             super().set_param(name, val)
 
@@ -115,7 +138,18 @@ class EmbeddingLayer(Layer):
         ids = inputs[0].reshape(inputs[0].shape[0], -1).astype(jnp.int32)
         out = jnp.take(params["wmat"], ids, axis=0)  # (b, s, d)
         if "wpos" in params:
-            out = out + params["wpos"][None, :, :].astype(out.dtype)
+            pos = _label_field(ctx, self.pos_key)
+            if pos is not None:
+                # packed documents: positions reset at each doc start —
+                # gather per (b, s) position ids instead of broadcasting
+                # the sequential table (eval forwards carry no label
+                # fields and fall back to sequential positions)
+                pidx = jnp.clip(pos.astype(jnp.int32), 0,
+                                params["wpos"].shape[0] - 1)
+                out = out + jnp.take(params["wpos"], pidx,
+                                     axis=0).astype(out.dtype)
+            else:
+                out = out + params["wpos"][None, :, :].astype(out.dtype)
         out = out[:, None, :, :]
         return [seq_constraint(out, ctx)], buffers
 
@@ -245,18 +279,25 @@ class AttentionLayer(Layer):
     type_names = ("attention",)
     extra_config_keys = (
         K("nhead", "int", lo=1), K("causal", "int", lo=0, hi=1),
+        K("segment_key", "str",
+          help="label field with per-position segment ids (packed "
+               "documents, io/text.py): attention is block-diagonal — "
+               "cross-segment scores masked, segment 0 = padding"),
     )
 
     def __init__(self):
         super().__init__()
         self.nhead = 0
         self.causal = 0
+        self.segment_key = ""
 
     def set_param(self, name, val):
         if name == "nhead":
             self.nhead = int(val)
         elif name == "causal":
             self.causal = int(val)
+        elif name == "segment_key":
+            self.segment_key = val
         else:
             super().set_param(name, val)
 
@@ -291,10 +332,13 @@ class AttentionLayer(Layer):
             qkv = qkv + params["bqkv"].astype(x.dtype)
         qkv = qkv.reshape(b, s, 3, h, hd).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # (b, h, s, hd)
+        seg = _label_field(ctx, self.segment_key)
+        if seg is not None:
+            seg = seg.astype(jnp.int32)  # (b, s) doc segments; 0 = pad
         mesh = _seq_mesh(ctx)
         if mesh is not None and s % mesh.shape["seq"] == 0:
             att = ring.sharded_attention(q, k, v, mesh,
-                                         causal=bool(self.causal))
+                                         causal=bool(self.causal), seg=seg)
         else:
             if mesh is not None:
                 warnings.warn(
@@ -302,7 +346,8 @@ class AttentionLayer(Layer):
                     f"seq mesh axis ({mesh.shape['seq']}); falling back to "
                     "dense attention, which gathers the full sequence on "
                     "one device", stacklevel=2)
-            att = _single_device_attention(q, k, v, bool(self.causal))
+            att = _single_device_attention(q, k, v, bool(self.causal),
+                                           seg=seg)
         att = att.transpose(0, 2, 1, 3).reshape(b, 1, s, d)
         out = jnp.einsum("bcsd,nd->bcsn", att, params["wout"].astype(x.dtype))
         if "bout" in params:
@@ -320,19 +365,49 @@ class SoftmaxSeqLayer(LossLayerBase):
     image losses (inherited from LossLayerBase).  forward is overridden
     because the (b, s, V) structure must survive — the base class flattens
     to (b, s*V).
+
+    ``packed = 1`` (document-packed rows, io/text.py): target ids < 0
+    mark positions whose next token crosses a document boundary or is
+    padding — they contribute zero loss AND zero gradient, and the
+    per-instance mean divides by the VALID-token count, so a row's loss
+    weight does not depend on how many doc boundaries it packed.
     """
 
     type_names = ("softmax_seq",)
+    extra_config_keys = (
+        K("packed", "int", lo=0, hi=1,
+          help="mask target ids < 0 (packed-document boundaries/padding) "
+               "out of the loss; mean over valid tokens only"),
+    )
+
+    def __init__(self):
+        super().__init__()
+        self.packed = 0
+
+    def set_param(self, name, val):
+        if name == "packed":
+            self.packed = int(val)
+        else:
+            super().set_param(name, val)
 
     def forward(self, params, buffers, inputs, ctx):
         self.check_n_inputs(inputs, 1)
         x = inputs[0]  # (b, 1, s, V)
         out = jax.nn.softmax(x, axis=-1)
         if ctx.labels is not None and ctx.train:
-            y = ctx.labels.get(self.target).astype(jnp.int32)  # (b, s)
+            y = ctx.labels.get(self.target)  # (b, s) float ids
             logp = jax.nn.log_softmax(x[:, 0].astype(jnp.float32), axis=-1)
-            tok = jnp.take_along_axis(logp, y[:, :, None], axis=2)[:, :, 0]
-            per_inst = -tok.mean(axis=1)  # mean per-token nats, per instance
+            yi = y.astype(jnp.int32)
+            if self.packed:
+                valid = (y >= 0).astype(jnp.float32)
+                tok = jnp.take_along_axis(
+                    logp, jnp.maximum(yi, 0)[:, :, None], axis=2)[:, :, 0]
+                per_inst = -(tok * valid).sum(axis=1) \
+                    / jnp.maximum(valid.sum(axis=1), 1.0)
+            else:
+                tok = jnp.take_along_axis(
+                    logp, yi[:, :, None], axis=2)[:, :, 0]
+                per_inst = -tok.mean(axis=1)  # mean per-token nats
             if ctx.labels.mask is not None:
                 # tail-batch replica padding is masked out, same contract
                 # as LossLayerBase (DataBatch.tail_mask_padd)

@@ -976,6 +976,19 @@ def _causal_mask(s, i, j, bq, bk):
     return jnp.where(qpos >= kpos, s, NEG_INF)
 
 
+def _segment_mask(s, i, j, bq, bk, segq, segk):
+    """Document-packing segment mask on an already-causal-masked score
+    block: keep (same segment & segment != 0) | diagonal.  The diagonal
+    stays unconditionally allowed so padding rows (segment 0) attend
+    themselves and the online softmax never renormalizes a fully-masked
+    row — the SAME rule as the lax fallback (parallel/ring.py module
+    docstring), which the pairtests hold this kernel to."""
+    qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    same = (segq[:, None] == segk[None, :]) & (segq[:, None] != 0)
+    return jnp.where(same | (qpos == kpos), s, NEG_INF)
+
+
 def _fa_fwd_init(acc, m, l):
     acc[...] = jnp.zeros_like(acc)
     m[...] = jnp.full_like(m, NEG_INF)
@@ -983,9 +996,9 @@ def _fa_fwd_init(acc, m, l):
 
 
 def _fa_fwd_step(i, j, q_ref, k_ref, v_ref, acc, m, l, *, scale, causal,
-                 bq, bk):
+                 bq, bk, segq=None, segk=None):
     """One online-softmax block update — the SINGLE copy of the forward
-    math, shared by the dense and triangular-grid kernels."""
+    math, shared by the dense, triangular-grid, and segmented kernels."""
     # keep matmul operands in the input dtype (bf16 hits the MXU's fast
     # path); accumulate in f32 via preferred_element_type
     qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
@@ -993,6 +1006,8 @@ def _fa_fwd_step(i, j, q_ref, k_ref, v_ref, acc, m, l, *, scale, causal,
                             preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, i, j, bq, bk)
+    if segq is not None:
+        s = _segment_mask(s, i, j, bq, bk, segq, segk)
     m_prev = m[...]
     m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
@@ -1032,7 +1047,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
 
 
 def _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
-             scale, causal, bq, bk):
+             scale, causal, bq, bk, segq=None, segk=None):
     """Recompute p and ds for one block pair — the SINGLE copy of the
     backward score math, shared by dq/dkv in both grid forms."""
     qb, kb = q_ref[0], k_ref[0]
@@ -1040,6 +1055,8 @@ def _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
                             preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, i, j, bq, bk)
+    if segq is not None:
+        s = _segment_mask(s, i, j, bq, bk, segq, segk)
     p = jnp.exp(s - lse_ref[0, 0, pl.ds(i * bq, bq)][:, None])
     dob = do_ref[0]
     dp = jax.lax.dot_general(dob, v_ref[0], (((1,), (1,)), ((), ())),
@@ -1049,10 +1066,11 @@ def _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
 
 def _fa_dq_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dq_acc, *, scale, causal, bq, bk):
+                dq_acc, *, scale, causal, bq, bk, segq=None, segk=None):
     _, ds, _, _, kb = _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref,
                                lse_ref, delta_ref, scale=scale,
-                               causal=causal, bq=bq, bk=bk)
+                               causal=causal, bq=bq, bk=bk,
+                               segq=segq, segk=segk)
     dq_acc[...] += jax.lax.dot_general(
         ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -1081,10 +1099,12 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _fa_dkv_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                 dk_acc, dv_acc, *, scale, causal, bq, bk):
+                 dk_acc, dv_acc, *, scale, causal, bq, bk,
+                 segq=None, segk=None):
     p, ds, dob, qb, _ = _fa_p_ds(i, j, q_ref, k_ref, v_ref, do_ref,
                                  lse_ref, delta_ref, scale=scale,
-                                 causal=causal, bq=bq, bk=bk)
+                                 causal=causal, bq=bq, bk=bk,
+                                 segq=segq, segk=segk)
     dv_acc[...] += jax.lax.dot_general(
         p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -1361,6 +1381,191 @@ def _flash_bwd_res(causal, scale, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd_res, _flash_bwd_res)
+
+
+# --------------------------------------------------------------------------
+# Segment-masked causal flash attention (document packing, io/text.py).
+# Same triangular live-pair grid as the causal kernels — segment masking
+# only REMOVES scores inside live blocks, so the grid, block specs, and
+# online-softmax state are unchanged; the per-position segment-id row
+# rides as one (1, 1, s) int32 block exactly like lse/delta.  The mask
+# rule is shared verbatim with the lax fallback (_segment_mask /
+# parallel/ring.py), and the interpret-mode pairtests hold the two paths
+# together (tests/test_text.py).
+
+
+def _fa_seg_slices(seg_ref, i, j, bq, bk):
+    return (seg_ref[0, 0, pl.ds(i * bq, bq)],
+            seg_ref[0, 0, pl.ds(j * bk, bk)])
+
+
+def _fa_fwd_kernel_tri_seg(ii_ref, jj_ref, q_ref, k_ref, v_ref, seg_ref,
+                           o_ref, lse_ref, acc, m, l, *, scale, bq, bk):
+    t = pl.program_id(1)
+    i, j = ii_ref[t], jj_ref[t]
+    jlast = (i * bq + bq - 1) // bk
+
+    @pl.when(j == 0)
+    def _():
+        _fa_fwd_init(acc, m, l)
+
+    segq, segk = _fa_seg_slices(seg_ref, i, j, bq, bk)
+    _fa_fwd_step(i, j, q_ref, k_ref, v_ref, acc, m, l, scale=scale,
+                 causal=True, bq=bq, bk=bk, segq=segq, segk=segk)
+
+    @pl.when(j == jlast)
+    def _():
+        _fa_fwd_emit(i, o_ref, lse_ref, acc, m, l, bq)
+
+
+def _fa_dq_kernel_tri_seg(ii_ref, jj_ref, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, delta_ref, seg_ref, dq_ref, dq_acc,
+                          *, scale, bq, bk):
+    t = pl.program_id(1)
+    i, j = ii_ref[t], jj_ref[t]
+    jlast = (i * bq + bq - 1) // bk
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    segq, segk = _fa_seg_slices(seg_ref, i, j, bq, bk)
+    _fa_dq_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_acc, scale=scale, causal=True, bq=bq, bk=bk,
+                segq=segq, segk=segk)
+
+    @pl.when(j == jlast)
+    def _():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel_tri_seg(ii_ref, jj_ref, q_ref, k_ref, v_ref, do_ref,
+                           lse_ref, delta_ref, seg_ref, dk_ref, dv_ref,
+                           dk_acc, dv_acc, *, scale, bq, bk, nq):
+    t = pl.program_id(1)
+    i, j = ii_ref[t], jj_ref[t]
+    ifirst = (j * bk) // bq
+
+    @pl.when(i == ifirst)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    segq, segk = _fa_seg_slices(seg_ref, i, j, bq, bk)
+    _fa_dkv_step(i, j, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_acc, dv_acc, scale=scale, causal=True, bq=bq, bk=bk,
+                 segq=segq, segk=segk)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_seg_fwd(q3, k3, v3, seg3, scale, interpret):
+    nbh, s_len, d = q3.shape
+    bq, bk = _fa_blocks(s_len, d)
+    ii, jj = _fa_tri_pairs(s_len // bq, s_len // bk, bq, bk, "ij")
+    q_spec, k_spec, row_spec = _fa_tri_specs(s_len, d, bq, bk)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(nbh, ii.shape[0]),
+        in_specs=[q_spec, k_spec, k_spec, row_spec],
+        out_specs=[q_spec, row_spec],
+        scratch_shapes=_scratch((bq, d), (bq, 1), (bq, 1)))
+    kern = functools.partial(_fa_fwd_kernel_tri_seg, scale=scale,
+                             bq=bq, bk=bk)
+    return pl.pallas_call(
+        kern, grid_spec=gs, interpret=interpret,
+        out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                   jax.ShapeDtypeStruct((nbh, 1, s_len), jnp.float32)],
+    )(ii, jj, q3, k3, v3, seg3)
+
+
+def _fa_seg_bwd(q3, k3, v3, seg3, o3, lse, g3, scale, interpret):
+    nbh, s_len, d = q3.shape
+    delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    bq, bk = _fa_blocks(s_len, d)
+    nq, nk = s_len // bq, s_len // bk
+    q_spec, k_spec, row_spec = _fa_tri_specs(s_len, d, bq, bk)
+    ii, jj = _fa_tri_pairs(nq, nk, bq, bk, "ij")
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(nbh, ii.shape[0]),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
+                  row_spec],
+        out_specs=q_spec,
+        scratch_shapes=_scratch((bq, d)))
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel_tri_seg, scale=scale, bq=bq, bk=bk),
+        grid_spec=gs, interpret=interpret,
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+    )(ii, jj, q3, k3, v3, g3, lse, delta, seg3)
+    ii2, jj2 = _fa_tri_pairs(nq, nk, bq, bk, "ji")
+    gs2 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(nbh, ii2.shape[0]),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
+                  row_spec],
+        out_specs=[k_spec, k_spec],
+        scratch_shapes=_scratch((bk, d), (bk, d)))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel_tri_seg, scale=scale, bq=bq,
+                          bk=bk, nq=nq),
+        grid_spec=gs2, interpret=interpret,
+        out_shape=[jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+                   jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+    )(ii2, jj2, q3, k3, v3, g3, lse, delta, seg3)
+    return dq, dk, dv
+
+
+def _seg_tile(seg, h):
+    """(b, s) segment ids -> the kernels' (b*h, 1, s) int32 layout
+    (b-major, matching ``q.reshape(b*h, s, d)``)."""
+    return jnp.repeat(seg.astype(jnp.int32)[:, None, :], h, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_seg(q, k, v, seg, scale, interpret):
+    out, _ = _flash_seg_fwd_res(q, k, v, seg, scale, interpret)
+    return out
+
+
+def _flash_seg_fwd_res(q, k, v, seg, scale, interpret):
+    scale, interpret = _norm_args(q, True, scale, interpret)
+    b, h, s_len, d = q.shape
+    sh3 = (b * h, s_len, d)
+    seg3 = _seg_tile(seg, h)
+    o3, lse = _fa_seg_fwd(q.reshape(sh3), k.reshape(sh3), v.reshape(sh3),
+                          seg3, scale, interpret)
+    return o3.reshape(q.shape), (q, k, v, seg, o3, lse)
+
+
+def _flash_seg_bwd_res(scale, interpret, res, g):
+    q, k, v, seg, o3, lse = res
+    scale, interpret = _norm_args(q, True, scale, interpret)
+    b, h, s_len, d = q.shape
+    sh3 = (b * h, s_len, d)
+    dq, dk, dv = _fa_seg_bwd(q.reshape(sh3), k.reshape(sh3),
+                             v.reshape(sh3), _seg_tile(seg, h), o3, lse,
+                             g.reshape(sh3), scale, interpret)
+    import numpy as _np
+    dseg = _np.zeros(seg.shape, jax.dtypes.float0)  # int input: no tangent
+    return (dq.reshape(q.shape), dk.reshape(k.shape),
+            dv.reshape(v.shape), dseg)
+
+
+_flash_seg.defvjp(_flash_seg_fwd_res, _flash_seg_bwd_res)
+
+
+def flash_attention_segmented(q, k, v, seg, scale=None, interpret=None):
+    """Segment-masked causal flash attention, (b, h, s, d) + (b, s) int
+    segment ids -> (b, h, s, d).
+
+    Block-diagonal causal masking for packed documents (segment 0 =
+    padding; the diagonal is always allowed — see ``_segment_mask``).
+    Same availability gate as :func:`flash_attention`
+    (``flash_attention_available``); ``interpret`` defaults to off-TPU
+    detection so the CPU pairtests run this exact code."""
+    return _flash_seg(q, k, v, seg, scale, interpret)
 
 
 # --------------------------------------------------------------------------

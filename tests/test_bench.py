@@ -119,6 +119,29 @@ def test_bench_serve_mode():
                 if t.name.startswith("cxxnet-serve")]
 
 
+def test_bench_lm_mode():
+    """--lm --tiny payload: tokens/sec + packing efficiency + per-axis
+    comm-share fields for both LM flagships on the CPU mesh (shares are
+    zero-valued but PRESENT on CPU traces, like --dp-scaling)."""
+    import bench
+    payload = bench.bench_lm(["--tiny", "dev=cpu", "steps=2",
+                              "models=longctx"])
+    assert payload["metric"] == "lm_tokens_per_sec"
+    assert payload["value"] > 0
+    assert payload["packing_efficiency"] >= 0.9
+    assert isinstance(payload["comm_share_per_axis"], dict)
+    pt = payload["models"]["longctx"]
+    assert pt["mesh"] == "data:2,seq:2"
+    assert pt["tokens_per_sec"] > 0
+    assert pt["tokens_per_sec_per_chip"] > 0
+    # the stream-chop packer wastes nothing; the whole-doc packer's
+    # number on the same corpus is the comparison baseline
+    assert pt["packing_efficiency"] == 1.0
+    assert 0 < pt["packing_efficiency_nosplit"] <= 1.0
+    assert np.isfinite(pt["loss"])
+    assert 0.0 <= pt["comm_share"] <= 1.0
+
+
 def test_comm_axis_shares_mapping():
     """Per-axis attribution table: data reductions vs model gathers."""
     import bench

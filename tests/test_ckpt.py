@@ -479,7 +479,11 @@ def _snapshot_arrays(path):
 
 class _KillAtBatch(IIterator):
     """Raises mid-round after ``at`` batches — the process-kill stand-in
-    (everything after the last committed snapshot is lost either way)."""
+    (everything after the last committed snapshot is lost either way).
+    Transparent to the resume contract: it simulates a dead process, not
+    a pipeline stage, so state() must be the BASE's state verbatim (the
+    resumed run rebuilds the chain without the wrapper; the default
+    IIterator.state would nest it under "base" and corrupt the capture)."""
 
     class Killed(Exception):
         pass
@@ -499,6 +503,12 @@ class _KillAtBatch(IIterator):
         if b is not None:
             self.count += 1
         return b
+
+    def state(self):
+        return self.base.state()
+
+    def set_state(self, st):
+        self.base.set_state(st)
 
 
 @pytest.mark.slow
@@ -778,6 +788,142 @@ rollback = 1
             task2.itr_train.close()
             for it in task2.itr_evals:
                 it.close()
+
+
+# ------------------------------------------------ text/LM iterator chain
+
+def _write_lm_corpus(tmp_path, n_docs=120, vocab=32, mean_len=12):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from make_synth_text import gen_docs
+    from cxxnet_tpu.io.text import write_token_shard
+    docs = gen_docs(n_docs, vocab=vocab, mean_len=mean_len, seed=5)
+    for s in range(2):
+        write_token_shard(str(tmp_path / f"lm_{s}.tok"), docs[s::2])
+    return sum(d.size for d in docs)
+
+
+def _write_lm_conf(tmp_path, model_dir, extra=""):
+    from cxxnet_tpu.models import transformer
+    net = transformer(vocab=32, seq=16, dim=16, nlayer=1, nhead=2,
+                      packed=True)
+    conf = tmp_path / f"{os.path.basename(model_dir)}_lm.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = text
+  path_tok = {tmp_path}/lm_%d.tok
+  tok_count = 2
+  shuffle = 1
+iter = packseq
+  seqlen = 16
+iter = end
+{net}
+batch_size = 4
+updater = adam
+eta = 0.005
+num_round = 6
+model_dir = {model_dir}
+save_model = 1
+ckpt_async = 1
+silent = 1
+eval_train = 0
+{extra}
+""")
+    return conf
+
+
+def _lm_batches_in_rounds(tmp_path, n_rounds):
+    """Deterministic batch count of the first ``n_rounds`` epochs of the
+    text+packseq chain (the ragged carry makes per-epoch counts vary)."""
+    from cxxnet_tpu.io.text import PackedSeqIterator, TextIterator
+    it = TextIterator()
+    it.set_param("path_tok", str(tmp_path / "lm_%d.tok"))
+    it.set_param("tok_count", "2")
+    it.set_param("shuffle", "1")
+    it.set_param("silent", "1")
+    p = PackedSeqIterator(it)
+    p.set_param("seqlen", "16")
+    p.set_param("batch_size", "4")
+    p.init()
+    n = 0
+    for _ in range(n_rounds):
+        p.before_first()
+        while p.next() is not None:
+            n += 1
+    return n
+
+
+@pytest.mark.slow
+def test_text_kill_resume_trajectory_bitwise(tmp_path):
+    """Kill-resume through TextIterator + PackedSeqIterator: the kill
+    lands MID-EPOCH with the packer's ragged buffer non-empty at every
+    round boundary, so the resumed run must restore the buffered
+    token/uid/position stream bitwise — final snapshots (params, opt,
+    rng, iterator chain incl. the ragged buffer) must agree with the
+    unkilled run's."""
+    _write_lm_corpus(tmp_path)
+    conf_a = _write_lm_conf(tmp_path, str(tmp_path / "LA"))
+    _run_task(_make_task(conf_a))
+    # the pack buffer must actually be ragged at the boundary, or this
+    # test wouldn't exercise the carry
+    ma, _ = _snapshot_arrays(str(tmp_path / "LA" / "0006.ckpt"))
+    pack_state = ma["extra"]["iter_state"]
+    assert len(pack_state["tok"]) > 0, "corpus must leave a ragged carry"
+    assert pack_state["base"]["gen"] == 6
+
+    conf_b = _write_lm_conf(tmp_path, str(tmp_path / "LB"))
+    task_b = _make_task(conf_b)
+    task_b.init()
+    kill_at = _lm_batches_in_rounds(tmp_path, 4) + 3  # mid round 5
+    task_b.itr_train = _KillAtBatch(task_b.itr_train, at=kill_at)
+    with pytest.raises(_KillAtBatch.Killed):
+        try:
+            task_b.task_train()
+        finally:
+            task_b.net.metrics.close()
+    assert ckptlib.validate_snapshot(str(tmp_path / "LB" / "0004.ckpt"))
+    _run_task(_make_task(conf_b, "continue=1"))
+
+    mb, fb = _snapshot_arrays(str(tmp_path / "LB" / "0006.ckpt"))
+    _, fa = _snapshot_arrays(str(tmp_path / "LA" / "0006.ckpt"))
+    assert fa.keys() == fb.keys()
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+    assert ma["extra"]["iter_state"] == mb["extra"]["iter_state"]
+    tsa, tsb = ma["extra"]["train_state"], mb["extra"]["train_state"]
+    assert tsa["sample_counter"] == tsb["sample_counter"]
+    assert tsa["rng_key"] == tsb["rng_key"]
+
+
+def test_text_stateless_stage_cold_resume_warns_once(tmp_path, capsys,
+                                                     monkeypatch):
+    """A text stage without resume support (the native C++ iterator
+    discipline: state() raises) must warn ONCE and snapshot without
+    iterator state — cold resume, never a crash or a silent {}."""
+    from cxxnet_tpu.io.text import TextIterator
+    _write_lm_corpus(tmp_path, n_docs=30)
+    conf = _write_lm_conf(tmp_path, str(tmp_path / "LC"))
+    task = _make_task(conf)
+    task.init()
+
+    def raising_state(self):
+        raise NotImplementedError(
+            "stateless text stage resumes cold")
+
+    monkeypatch.setattr(TextIterator, "state", raising_state)
+    try:
+        extra = task._ckpt_extra_state()
+        assert "iter_state" not in extra
+        extra2 = task._ckpt_extra_state()
+        assert "iter_state" not in extra2
+    finally:
+        task.net.metrics.close()
+        for it in [task.itr_train] + task.itr_evals:
+            it.close()
+    err = capsys.readouterr().err
+    assert err.count("iterator state capture failed") == 1
 
 
 # --------------------------------------------------------- lint rules

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Synthetic LM corpus generator: zipf-ish document lengths + learnable
+first-order n-gram structure (the text analogue of make_synth_mnist.py).
+
+Documents are token-id sequences drawn from a sparse first-order Markov
+chain: from token ``t`` the next token is one of a handful of fixed
+successors ``(a*t + b + j) mod vocab`` (j < branch), chosen uniformly.
+The conditional entropy is therefore ``log(branch)`` nats — far below
+the unigram ``log(vocab)`` a model starts at — so a causal LM's loss
+demonstrably falls as it learns the transition table (the CONVERGENCE
+signal), while document lengths follow a truncated zipf so the packer
+(`io/text.py::PackedSeqIterator`) sees realistic length skew.
+
+Writes a plain-text corpus (one document per line, space-separated
+integer token ids — the ``tools/tok2bin.py`` input format), and with
+``--pack N`` also packs it straight into N token shards.
+
+    python tools/make_synth_text.py --out corpus.txt --docs 2000 \
+        --vocab 512 --pack 4 --shard-prefix corpus_%d.tok
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def gen_docs(n_docs: int, vocab: int, mean_len: int, branch: int = 2,
+             zipf_a: float = 1.5, seed: int = 0, min_len: int = 4,
+             max_len: int = 0):
+    """List of int32 token arrays with zipf-ish lengths and Markov
+    structure (module docstring).  ``max_len`` 0 = 8x mean."""
+    assert vocab >= 4 and branch >= 1 and branch < vocab
+    rnd = np.random.RandomState(seed)
+    max_len = max_len or 8 * mean_len
+    a_mul = 2 * (vocab // 3) + 1  # odd multiplier: good token mixing
+    docs = []
+    for _ in range(n_docs):
+        # zipf over "length units", scaled to the mean: heavy-tailed like
+        # real document collections, truncated so one doc can't swallow
+        # an epoch
+        ln = int(min(min_len + (rnd.zipf(zipf_a) - 1) * (mean_len // 2),
+                     max_len))
+        toks = np.empty(ln, np.int64)
+        toks[0] = rnd.randint(0, vocab)
+        for i in range(1, ln):
+            j = rnd.randint(0, branch)
+            toks[i] = (a_mul * toks[i - 1] + 7 + j) % vocab
+        docs.append(toks.astype(np.int32))
+    return docs
+
+
+def write_corpus(path: str, docs) -> None:
+    with open(path, "w") as f:
+        for d in docs:
+            f.write(" ".join(str(int(t)) for t in d) + "\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="corpus .txt output path")
+    ap.add_argument("--docs", type=int, default=2000)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--mean-len", type=int, default=64)
+    ap.add_argument("--branch", type=int, default=2,
+                    help="successors per token; conditional entropy = "
+                         "log(branch) nats")
+    ap.add_argument("--zipf-a", type=float, default=1.5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pack", type=int, default=0, metavar="N",
+                    help="also pack into N token shards via tok2bin")
+    ap.add_argument("--shard-prefix", default="",
+                    help="shard path with %%d (default: <out>_%%d.tok)")
+    args = ap.parse_args()
+
+    docs = gen_docs(args.docs, args.vocab, args.mean_len, args.branch,
+                    args.zipf_a, args.seed)
+    write_corpus(args.out, docs)
+    ntok = sum(d.size for d in docs)
+    print(f"make_synth_text: {len(docs)} docs / {ntok} tokens "
+          f"(vocab {args.vocab}, branch {args.branch} -> conditional "
+          f"entropy {np.log(args.branch):.3f} nats) -> {args.out}")
+    if args.pack > 0:
+        from tok2bin import pack_shards
+        prefix = args.shard_prefix or \
+            os.path.splitext(args.out)[0] + "_%d.tok"
+        n = pack_shards(docs, prefix, args.pack, vocab=args.vocab)
+        print(f"make_synth_text: packed {n} docs into {args.pack} "
+              f"shard(s) at {prefix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

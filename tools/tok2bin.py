@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""tok2bin: pack tokenized documents into CXTPUTOK token shards.
+
+The im2bin analogue for the LM data path (`cxxnet_tpu/io/text.py` has
+the format spec): input is a plain-text corpus — one document per line,
+space-separated integer token ids (what `tools/make_synth_text.py`
+writes, and what any external tokenizer can trivially emit) — output is
+``--num-shards`` memory-mappable token shards with a doc-offset index.
+Documents round-robin across shards so every shard sees the full length
+distribution (the partition_maker discipline).
+
+    python tools/tok2bin.py --corpus corpus.txt --out corpus_%d.tok \
+        --num-shards 4
+
+``--vocab`` (optional) validates ids and picks the narrowest itemsize
+(uint16 when vocab <= 65536, else uint32).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def read_corpus(path: str):
+    """Token-id documents from a one-doc-per-line text corpus."""
+    docs = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            toks = line.split()
+            if not toks:
+                continue
+            try:
+                docs.append(np.asarray([int(t) for t in toks], np.int64))
+            except ValueError as e:
+                raise ValueError(
+                    f"{path} line {lineno}: expected space-separated "
+                    f"integer token ids ({e})")
+    return docs
+
+
+def pack_shards(docs, out_pattern: str, num_shards: int,
+                vocab: int = 0) -> int:
+    """Round-robin ``docs`` into ``num_shards`` CXTPUTOK files at
+    ``out_pattern`` (must contain %d when num_shards > 1).  Returns the
+    number of documents packed."""
+    from cxxnet_tpu.io.text import write_token_shard
+    assert num_shards >= 1
+    if num_shards > 1:
+        assert "%d" in out_pattern, \
+            "--out must contain %d when --num-shards > 1"
+    maxid = max((int(d.max()) for d in docs if len(d)), default=0)
+    if vocab:
+        assert maxid < vocab, \
+            f"token id {maxid} out of range for vocab {vocab}"
+    itemsize = 2 if max(maxid + 1, vocab) <= (1 << 16) else 4
+    n = 0
+    for s in range(num_shards):
+        shard_docs = docs[s::num_shards]
+        path = out_pattern % s if "%d" in out_pattern else out_pattern
+        n += write_token_shard(path, shard_docs, itemsize=itemsize)
+    return n
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--corpus", required=True,
+                    help="one doc per line, space-separated token ids")
+    ap.add_argument("--out", required=True,
+                    help="shard path; %%d substituted when sharding")
+    ap.add_argument("--num-shards", type=int, default=1)
+    ap.add_argument("--vocab", type=int, default=0,
+                    help="validate ids < vocab and size the itemsize")
+    args = ap.parse_args()
+    docs = read_corpus(args.corpus)
+    assert docs, f"{args.corpus}: no documents"
+    n = pack_shards(docs, args.out, args.num_shards, vocab=args.vocab)
+    ntok = sum(d.size for d in docs)
+    print(f"tok2bin: {n} docs / {ntok} tokens -> {args.num_shards} "
+          f"shard(s) at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
