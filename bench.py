@@ -353,11 +353,17 @@ def bench_serve(argv=None) -> dict:
     throughput) rising with load while tail latency stays bounded by
     ``serve_max_wait_ms``.  Overridable ``key=value`` args: ``dev``,
     ``offered_qps`` (csv), ``duration`` (sec/point), ``clients``,
-    ``serve_shapes``, ``serve_dtype``, ``serve_max_wait_ms``;
+    ``serve_shapes``, ``serve_dtype``, ``serve_max_wait_ms``,
+    ``trace_sample`` (span-trace every Nth request and report the
+    per-stage p50/p95/p99 request-path decomposition per point —
+    doc/monitor.md "Reading a p99 breakdown");
     ``--tiny``/``tiny=1`` swaps in a small MLP and a short sweep for CI
     smokes."""
+    import os
+    import tempfile
     import threading
 
+    from cxxnet_tpu.monitor.spans import span_records, stage_decomposition
     from cxxnet_tpu.serve import ServeConfig, parse_shapes
     from cxxnet_tpu.serve.host import ServeModel
     from __graft_entry__ import _make_trainer
@@ -366,6 +372,7 @@ def bench_serve(argv=None) -> dict:
     dev = args.get("dev", "tpu")
     duration = float(args.get("duration", "0.5" if tiny else "2.0"))
     clients = int(args.get("clients", "4" if tiny else "8"))
+    trace_sample = int(args.get("trace_sample", "0"))
     qps_list = [float(q) for q in args.get(
         "offered_qps", "200" if tiny else "100,400,1600").split(",")]
     cfg = ServeConfig(
@@ -383,6 +390,34 @@ def bench_serve(argv=None) -> dict:
             IO_AB_NET + f"input_shape = 1,{side},{side}\n"
             "eta = 0.1\nsilent = 1\n", max(cfg.shapes), dev)
         in_shape = (1, side, side)
+    span_path = None
+    if trace_sample > 0:
+        # span tracing rides the trainer's own registry: reuse an
+        # already-configured sink (CXXNET_METRICS_SINK) or park the
+        # span records in a temp JSONL the stage table reads back
+        created_sink = not t.metrics.active
+        if created_sink:
+            fd, span_path = tempfile.mkstemp(
+                prefix="bench_serve_spans_", suffix=".jsonl")
+            os.close(fd)
+            t.metrics.configure_sink(f"jsonl:{span_path}")
+        else:
+            span_path = t.metrics.sink.path
+        t.metrics.configure_tracer(trace_sample)
+
+    def _read_spans():
+        if span_path is None:
+            return []
+        import json as _json
+        with open(span_path) as f:
+            recs = []
+            for line in f:
+                try:
+                    recs.append(_json.loads(line))
+                except ValueError:
+                    continue
+        return span_records(recs)
+
     sm = ServeModel(t, cfg, name="bench")
     t0 = time.perf_counter()
     sm.warmup()
@@ -390,6 +425,7 @@ def bench_serve(argv=None) -> dict:
     rnd = np.random.RandomState(0)
     pool = rnd.randn(256, *in_shape).astype(np.float32)
     points = []
+    spans_seen = len(_read_spans())
     try:
         for qps in qps_list:
             lats, errs = [], []
@@ -454,8 +490,26 @@ def bench_serve(argv=None) -> dict:
                   f"{points[-1]['p50_ms']}ms p95={points[-1]['p95_ms']}ms "
                   f"mean_batch={points[-1]['mean_batch']}",
                   file=sys.stderr)
+            if span_path is not None:
+                # per-point request-path decomposition: only the spans
+                # this offered-QPS point produced
+                all_spans = _read_spans()
+                dec = stage_decomposition(all_spans[spans_seen:])
+                spans_seen = len(all_spans)
+                if dec["stages"]:
+                    points[-1]["stages"] = dec["stages"]
+                    points[-1]["traced_requests"] = dec["requests"]
+                    print("bench: serve stage p99 (ms): " + "  ".join(
+                        f"{s['stage']}={s['p99_ms']:g}"
+                        for s in dec["stages"]), file=sys.stderr)
     finally:
         sm.close()
+        if span_path is not None and created_sink:
+            t.metrics.close()  # the temp span sink is ours to close
+            try:
+                os.remove(span_path)
+            except OSError:
+                pass
     return {
         "metric": "serve_p95_ms",
         "value": points[-1]["p95_ms"] if points else 0.0,
@@ -465,6 +519,7 @@ def bench_serve(argv=None) -> dict:
         "clients": clients,
         "warmup_sec": round(warmup_sec, 3),
         "retraces": sm.retraces,
+        "trace_sample": trace_sample,
         "points": points,
     }
 

@@ -307,6 +307,14 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                         "profile per-batch dispatch — not the grouped "
                         "steady state the run would otherwise have"))
     sink_on = last.get("metrics_sink", "") not in ("", "none", "0")
+    # host-side span tracing (doc/monitor.md): the trace_sample value
+    # itself is bounds-checked by its KeySpec (int, 0..1e6); here only
+    # the cross-key dependency — spans ride the JSONL sink
+    if _as_int(last, "trace_sample", 0) > 0 and not sink_on:
+        add(Finding("warn", "trace_sample",
+                    "trace_sample > 0 without metrics_sink: span "
+                    "records have nowhere to land, so the tracer stays "
+                    "disarmed; set metrics_sink = jsonl:<path>"))
     if _as_int(last, "sentinel", 0):
         if not sink_on:
             add(Finding("warn", "sentinel",
@@ -432,12 +440,24 @@ def _serve_rules(last: Dict[str, str], task: str, add) -> None:
     if task != "serve":
         for k in ("serve_shapes", "serve_max_batch", "serve_max_wait_ms",
                   "serve_dtype", "serve_clients", "serve_calib",
-                  "serve_queue_depth"):
+                  "serve_queue_depth", "serve_sentinel",
+                  "serve_sentinel_window"):
             if k in last:
                 add(Finding("warn", k,
                             f"{k} has no effect without task = serve"))
                 break
         return
+    if _as_int(last, "serve_sentinel", 0):
+        if last.get("metrics_sink", "") in ("", "none", "0"):
+            add(Finding("warn", "serve_sentinel",
+                        "serve_sentinel = 1 without metrics_sink: "
+                        "serve_window and anomaly records have nowhere "
+                        "to land, so the sentinels disarm; set "
+                        "metrics_sink = jsonl:<path>"))
+    elif "serve_sentinel_window" in last:
+        add(Finding("warn", "serve_sentinel_window",
+                    "serve_sentinel_window has no effect without "
+                    "serve_sentinel = 1"))
     if last.get("serve_dtype", "f32") == "int8" \
             and _as_int(last, "serve_calib", 0) <= 0:
         add(Finding("warn", "serve_dtype",

@@ -93,7 +93,7 @@ _replace_write = atomic_write
 
 
 def write_snapshot(path: str, shards: Dict[str, Dict[str, np.ndarray]],
-                   meta: dict, fault_hook=None) -> dict:
+                   meta: dict, fault_hook=None, tracer=None) -> dict:
     """Write one snapshot dir atomically (manifest last).
 
     ``shards`` maps shard name -> flat ``{key: np.ndarray}`` (the
@@ -101,10 +101,17 @@ def write_snapshot(path: str, shards: Dict[str, Dict[str, np.ndarray]],
     the original dtypes recorded in ``meta``).  ``fault_hook`` is the
     crash-injection point for tests: called as ``fault_hook(stage)``
     after each shard and before the manifest — raising there leaves
-    exactly the partial state a kill at that byte would.
+    exactly the partial state a kill at that byte would.  ``tracer``
+    (a :class:`~cxxnet_tpu.monitor.spans.SpanTracer`) emits one
+    ``ckpt_shard`` span per shard (npz + fsync + crc read-back) and a
+    ``ckpt_manifest`` span for the commit — the writer-thread timeline
+    next to the train loop's in the Perfetto export.
 
     Returns stats: ``{"bytes": total, "shards": n}``.
     """
+    if tracer is None:
+        from ..monitor import spans as _spans
+        tracer = _spans.NULL
     os.makedirs(path, exist_ok=True)
     # overwriting a committed snapshot (a rollback retry re-saving the
     # same round): drop the manifest FIRST so a kill mid-rewrite leaves
@@ -116,16 +123,18 @@ def write_snapshot(path: str, shards: Dict[str, Dict[str, np.ndarray]],
     total = 0
     for name, arrays in shards.items():
         fpath = os.path.join(path, f"{name}.npz")
-        _replace_write(fpath, lambda f, a=arrays: np.savez(f, **a))
-        size = os.path.getsize(fpath)
-        # the crc is a deliberate read-BACK of the committed file (not a
-        # streaming accumulator: np.savez goes through zipfile, which
-        # seeks back to rewrite local headers, so linear crc-on-write
-        # would checksum bytes that never land); the manifest certifies
-        # what is actually on disk, and the extra read stays on the
-        # writer thread, off the training loop
-        shard_meta[name] = {"file": f"{name}.npz", "bytes": size,
-                            "crc32": _crc32(fpath)}
+        with tracer.span("ckpt_shard", shard=name):
+            _replace_write(fpath, lambda f, a=arrays: np.savez(f, **a))
+            size = os.path.getsize(fpath)
+            # the crc is a deliberate read-BACK of the committed file
+            # (not a streaming accumulator: np.savez goes through
+            # zipfile, which seeks back to rewrite local headers, so
+            # linear crc-on-write would checksum bytes that never
+            # land); the manifest certifies what is actually on disk,
+            # and the extra read stays on the writer thread, off the
+            # training loop
+            shard_meta[name] = {"file": f"{name}.npz", "bytes": size,
+                                "crc32": _crc32(fpath)}
         total += size
         if fault_hook is not None:
             fault_hook(f"shard:{name}")
@@ -133,9 +142,10 @@ def write_snapshot(path: str, shards: Dict[str, Dict[str, np.ndarray]],
         fault_hook("manifest")
     manifest = {"format_version": FORMAT_VERSION, "shards": shard_meta}
     manifest.update(meta)
-    _replace_write(
-        mpath, lambda f: f.write(
-            json.dumps(manifest, sort_keys=True).encode("utf-8")))
+    with tracer.span("ckpt_manifest"):
+        _replace_write(
+            mpath, lambda f: f.write(
+                json.dumps(manifest, sort_keys=True).encode("utf-8")))
     return {"bytes": total, "shards": len(shard_meta)}
 
 

@@ -63,11 +63,18 @@ class AsyncCheckpointWriter:
     snapshot (the task driver emits its ``ckpt`` record there, so the
     record lands even while the loop is mid-dispatch)."""
 
-    def __init__(self, depth: int = 1, on_done=None):
+    def __init__(self, depth: int = 1, on_done=None, tracer=None):
         self._queue: "queue.Queue[Optional[_Job]]" = queue.Queue(
             maxsize=max(int(depth), 1))
         self._failed: Optional[BaseException] = None
         self._on_done = on_done
+        # span tracing (monitor/spans.py): per-shard / manifest /
+        # prune spans on the writer thread, so the Perfetto export
+        # shows the off-thread write next to the train loop's timeline
+        if tracer is None:
+            from ..monitor import spans as _spans
+            tracer = _spans.NULL
+        self._tracer = tracer
         self._pending = 0
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
@@ -135,9 +142,11 @@ class AsyncCheckpointWriter:
             try:
                 t0 = time.perf_counter()
                 stats = write_snapshot(job.path, job.shards, job.meta,
-                                       fault_hook=FAULT_HOOK)
-                pruned = prune_snapshots(
-                    os.path.dirname(job.path) or ".", job.keep)
+                                       fault_hook=FAULT_HOOK,
+                                       tracer=self._tracer)
+                with self._tracer.span("ckpt_prune", keep=job.keep):
+                    pruned = prune_snapshots(
+                        os.path.dirname(job.path) or ".", job.keep)
                 stats.update(write_sec=time.perf_counter() - t0,
                              path=job.path, counter=job.counter,
                              pruned=pruned)

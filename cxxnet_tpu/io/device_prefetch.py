@@ -178,6 +178,13 @@ class DevicePrefetcher:
         self._gen = 0
         self._failed: Optional[BaseException] = None
         self._done = False
+        # span tracing (monitor/spans.py, trace_sample-sampled): item
+        # counters for the producer's staging span vs the consumer's
+        # queue-wait span — the pair that shows whether the input
+        # pipeline is producing ahead of the loop or the loop is
+        # waiting on it
+        self._span_staged = 0
+        self._span_waited = 0
 
     @property
     def async_(self) -> bool:
@@ -221,9 +228,9 @@ class DevicePrefetcher:
                     # stream order (trainer.evaluate's legacy rule)
                     if pending:
                         group, pending = pending, []
-                        yield self._stage(group), wait
+                        yield self._stage_traced(group), wait
                         wait = 0.0
-                    yield self._stage([b]), wait
+                    yield self._stage_traced([b]), wait
                     wait = 0.0
                     continue
                 if self.for_eval and self.group_n > 1:
@@ -235,10 +242,23 @@ class DevicePrefetcher:
                 pending.append(b)
             if pending and (done or len(pending) >= self.group_n):
                 group, pending = pending, []
-                yield self._stage(group), wait
+                yield self._stage_traced(group), wait
                 wait = 0.0
             if done:
                 return
+
+    def _stage_traced(self, group: List[DataBatch]) -> StagedItem:
+        """_stage plus the sampled ``prefetch_stage`` span (producer
+        side: host stack/cast/device_put/input_s2d wall per item)."""
+        tracer = getattr(self.metrics, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            n = self._span_staged
+            self._span_staged += 1
+            if tracer.sampled(n):
+                with tracer.span("prefetch_stage", batches=len(group),
+                                 mode="async" if self.async_ else "sync"):
+                    return self._stage(group)
+        return self._stage(group)
 
     # ------------------------------------------------------ thread plumbing
     def before_first(self) -> None:
@@ -288,7 +308,18 @@ class DevicePrefetcher:
                 raise
             return item
         assert self._queue is not None, "call before_first() first"
+        # consumer-side span: the loop's wall blocked on the producer
+        # (sampled; near-zero dur = producer is keeping up)
+        tracer = getattr(self.metrics, "tracer", None)
+        tok = None
+        if tracer is not None and tracer.enabled:
+            n = self._span_waited
+            self._span_waited += 1
+            if tracer.sampled(n):
+                tok = tracer.begin("prefetch_wait")
         v = self._queue.get()
+        if tok is not None:
+            tracer.end(tok)
         if v is None:
             self._done = True
             return None

@@ -599,7 +599,7 @@ class LearnTask:
             from .ckpt.writer import AsyncCheckpointWriter
             if self._ckpt_writer is None:
                 self._ckpt_writer = AsyncCheckpointWriter(
-                    on_done=self._ckpt_done)
+                    on_done=self._ckpt_done, tracer=metrics.tracer)
             shards, meta = self.net.checkpoint_payload(
                 with_opt=bool(self.save_opt), extra_state=extra_state)
             path = ckptlib.snapshot_path(self.name_model_dir, counter)
@@ -617,6 +617,13 @@ class LearnTask:
                 # block — never re-insert, that entry would leak
                 if counter in self._ckpt_blocked_sec:
                     self._ckpt_blocked_sec[counter] = pull + block
+            # span: what the TRAIN thread actually paid for this
+            # snapshot — the D2H host pull plus bounded-queue
+            # backpressure (write_sec - this span is the async win)
+            tr = metrics.tracer
+            if tr is not None and tr.enabled:
+                tr.emit("ckpt_blocked", t0, time.perf_counter(),
+                        counter=counter)
             return
         # legacy single-file path, now atomic (tmp + os.replace) and
         # carrying opt state + exact-resume state by default
@@ -1276,6 +1283,26 @@ class LearnTask:
                     f"serve: {cfg.dtype} pairtest vs f32 on "
                     f"{len(calib_rows)} calibration batch(es): max rel "
                     f"err {err:.3g} (envelope {SERVE_TOL[cfg.dtype]:g})")
+        # serve-side regression sentinels (doc/serve.md): a reporter
+        # thread samples the batcher's window stats every
+        # serve_sentinel_window seconds, emits one serve_window record,
+        # and feeds the SentinelBank's serve watchers (p99 rise / QPS
+        # drop / queue-depth rise) — the serving-regression signal the
+        # hot-swap/rollback machinery (ROADMAP item 4) consumes
+        bank = None
+        sentinel_stop = None
+        sentinel_thread = None
+        if cfg.sentinel:
+            if not metrics.active:
+                mlog.warn("serve_sentinel = 1 without an active "
+                          "metrics_sink: serve_window/anomaly records "
+                          "have nowhere to land; sentinels disarmed")
+            else:
+                from .monitor.sentinel import SentinelBank
+                bank = SentinelBank(metrics, rel=self.sentinel_rel,
+                                    warmup=self.sentinel_warmup,
+                                    ring=self.sentinel_ring)
+                sm.batcher.track_window = True
         # stream the request iterator: each VALID row of each pred batch
         # becomes one single-row request (round_batch padding excluded,
         # like predict_raw) fed through a BOUNDED work queue — the
@@ -1345,12 +1372,54 @@ class LearnTask:
                     abort.set()
                     return
 
+        def reporter(stop_evt):
+            win = 0
+            last_t = time.perf_counter()
+
+            def tick():
+                nonlocal win, last_t
+                ws = sm.batcher.window_stats()
+                now = time.perf_counter()
+                # qps over the ACTUAL elapsed window, not the nominal
+                # one: the tail tick at stop covers a partial window,
+                # and dividing by the full width would deflate qps and
+                # fire a spurious drop anomaly on every clean shutdown
+                dt, last_t = max(now - last_t, 1e-6), now
+                win += 1
+                rec = {"model": sm.name, "window": win,
+                       "window_sec": round(dt, 3),
+                       "requests": ws["requests"],
+                       "qps": round(ws["requests"] / dt, 2),
+                       "queue_depth": ws["queue_depth"]}
+                for k in ("p50_ms", "p95_ms", "p99_ms"):
+                    if k in ws:
+                        rec[k] = ws[k]
+                metrics.emit("serve_window", **rec)
+                # every window feeds the bank: an idle one (requests=0,
+                # so qps/p99 are falsy and skipped inside observe_serve)
+                # still drives the queue-depth watcher — a dispatcher
+                # stall grows the queue while NOTHING completes, the
+                # exact window the depth sentinel exists for
+                bank.observe_serve(rec)
+
+            while not stop_evt.wait(cfg.sentinel_window):
+                tick()
+            # drain the tail window at stop so a run shorter than one
+            # window still lands its serving stats
+            tick()
+
         t0 = time.perf_counter()
         threads = [threading.Thread(target=client, daemon=True,
                                     name=f"cxxnet-serve-client-{j}")
                    for j in range(cfg.clients)]
         prod = threading.Thread(target=producer, daemon=True,
                                 name="cxxnet-serve-producer")
+        if bank is not None:
+            sentinel_stop = threading.Event()
+            sentinel_thread = threading.Thread(
+                target=reporter, args=(sentinel_stop,), daemon=True,
+                name="cxxnet-serve-sentinel")
+            sentinel_thread.start()
         try:
             prod.start()
             for th in threads:
@@ -1360,6 +1429,8 @@ class LearnTask:
             prod.join()
             dur = time.perf_counter() - t0
             if errors:
+                if bank is not None:
+                    bank.flight_dump("serve aborted: " + repr(errors[0]))
                 raise errors[0]
             with open(self.name_pred, "w") as fo:
                 for i in range(n_total[0]):
@@ -1390,7 +1461,14 @@ class LearnTask:
                 f"({qps:.1f} req/s), {stats['batches']} dispatches "
                 f"(mean batch {stats['mean_batch']}), retraces "
                 f"{sm.retraces}")
+            if bank is not None and bank.anomalies:
+                mlog.warn(f"serve: {len(bank.anomalies)} sentinel "
+                          "anomaly(ies) — see the anomaly records "
+                          "(tools/obsv.py)")
         finally:
+            if sentinel_stop is not None:
+                sentinel_stop.set()
+                sentinel_thread.join()
             sm.close()
         mlog.notice(f"finished serving, wrote {self.name_pred}")
 

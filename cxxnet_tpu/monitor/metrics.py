@@ -24,6 +24,15 @@ import time
 from typing import Any, Dict, List, Optional, TextIO
 
 
+def nearest_rank(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a SORTED list: ceil(n*q/100)-1,
+    clamped.  The one copy shared by Histogram.summary, the serve
+    window stats (serve/batcher.py), and the span-table decomposition
+    (monitor/spans.py) — their p99s must agree by construction."""
+    i = max(math.ceil(len(sorted_vals) * q / 100.0) - 1, 0)
+    return sorted_vals[min(i, len(sorted_vals) - 1)]
+
+
 class Histogram:
     """Streaming summary (count/sum/min/max/last + p50/p95/p99).
 
@@ -64,11 +73,7 @@ class Histogram:
             if j < self._RESERVOIR:
                 self._samples[j] = v
 
-    @staticmethod
-    def _nearest_rank(s: List[float], q: float) -> float:
-        # nearest-rank: ceil(n*q/100) - 1, clamped to a valid index
-        i = max(math.ceil(len(s) * q / 100.0) - 1, 0)
-        return s[min(i, len(s) - 1)]
+    _nearest_rank = staticmethod(nearest_rank)
 
     def percentile(self, q: float) -> Optional[float]:
         """q in [0, 100]; nearest-rank over the reservoir."""
@@ -130,19 +135,31 @@ def create_sink(spec: str) -> Optional[JsonlSink]:
 
 
 class MetricsRegistry:
-    """Counters, gauges, histograms, and an optional record sink."""
+    """Counters, gauges, histograms, an optional record sink, and the
+    host-side span tracer (monitor/spans.py — disabled until
+    ``trace_sample`` arms it; components reach it as
+    ``metrics.tracer``, the one object every request-path layer
+    already shares)."""
 
     def __init__(self):
         self.counters: Dict[str, int] = {}
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
         self.sink: Optional[JsonlSink] = None
+        from .spans import SpanTracer
+        self.tracer = SpanTracer(self)
 
     # ------------------------------------------------------------- config
     def configure_sink(self, spec: str) -> None:
         if self.sink is not None:
             self.sink.close()
         self.sink = create_sink(spec)
+
+    def configure_tracer(self, sample: int) -> None:
+        """``trace_sample = N``: span-trace every Nth request (0 off).
+        Span records land only while the sink is active — the tracer
+        object itself is stable, so early-bound references stay live."""
+        self.tracer.configure(sample)
 
     @property
     def active(self) -> bool:

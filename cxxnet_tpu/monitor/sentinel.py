@@ -12,6 +12,14 @@ rolling-EWMA watchers over the three trend series every perf PR reads:
 * ``hbm_peak_bytes`` (round records) — memory high-water creep toward
   an OOM (direction ``rise``).
 
+Serving runs (``serve_sentinel = 1``, doc/serve.md) arm three more
+over the ``serve_window`` records the task's reporter thread emits:
+``serve_p99_ms`` (rise — tail-latency regression), ``serve_qps``
+(drop — throughput collapse), and ``serve_queue_depth`` (rise —
+standing-queue growth, the saturation precursor).  These are the
+serving-regression signal the hot-swap/rollback machinery (ROADMAP
+item 4) consumes.
+
 Each watcher smooths its series with an EWMA and fires an ``anomaly``
 record when a new value deviates more than ``sentinel_rel`` (relative)
 from the smoothed baseline in its bad direction, after
@@ -110,6 +118,16 @@ class SentinelBank:
                                    alpha),
             "hbm_peak_bytes": Sentinel("hbm_peak_bytes", "rise", rel,
                                        warmup, alpha),
+            # serve-side sentinels (doc/serve.md): fed by the
+            # ``serve_window`` records task_serve's reporter thread
+            # emits — the serving-regression signal the
+            # hot-swap/rollback machinery (ROADMAP item 4) acts on
+            "serve_p99_ms": Sentinel("serve_p99_ms", "rise", rel,
+                                     warmup, alpha),
+            "serve_qps": Sentinel("serve_qps", "drop", rel, warmup,
+                                  alpha),
+            "serve_queue_depth": Sentinel("serve_queue_depth", "rise",
+                                          rel, warmup, alpha),
         }
         self.anomalies: List[Dict] = []
 
@@ -149,11 +167,26 @@ class SentinelBank:
         if rec.get("comm_share"):
             self._check("comm_share", rec["comm_share"], rec)
 
+    def observe_serve(self, rec: Dict) -> None:
+        """One ``serve_window`` record: windowed p99 latency (rise),
+        achieved QPS (drop), and live queue depth (rise).  Windows
+        also enter the flight ring, so a serving anomaly dumps the
+        windows leading into it.  A zero queue-depth baseline never
+        fires (the Sentinel contract) — depth watching arms only once
+        the server actually runs a standing queue."""
+        self.ring.append(dict(rec, kind="serve_window"))
+        if rec.get("p99_ms"):
+            self._check("serve_p99_ms", rec["p99_ms"], rec)
+        if rec.get("qps"):
+            self._check("serve_qps", rec["qps"], rec)
+        if rec.get("queue_depth") is not None:
+            self._check("serve_queue_depth", rec["queue_depth"], rec)
+
     def _check(self, name: str, value: float, rec: Dict) -> None:
         hit = self.sentinels[name].observe(value)
         if hit is None:
             return
-        for k in ("round", "step", "global_step"):
+        for k in ("round", "step", "global_step", "window"):
             if k in rec:
                 hit[k] = rec[k]
         self.anomalies.append(hit)

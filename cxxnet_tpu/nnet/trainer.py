@@ -88,6 +88,10 @@ TRAINER_KEYS = (
     K("monitor_interval", "int", lo=1),
     K("monitor_nan", "enum", choices=("warn", "fatal", "off")),
     K("metrics_sink", "str", help="jsonl:<path> or none"),
+    K("trace_sample", "int", lo=0, hi=1000000,
+      help="host-side span tracing: trace every Nth request/item "
+           "through the request path (span records; 0 = off; needs "
+           "metrics_sink — doc/monitor.md)"),
     K("eval_train", "int", lo=0, hi=1), K("eval_group", "int", lo=1),
     K("input_s2d", "int", lo=0, hi=1), K("print_step", "int", lo=1),
     K("metric", "str", check=_metric_check,
@@ -234,6 +238,8 @@ class NetTrainer:
             self.monitor_nan = val
         elif name == "metrics_sink":
             self.metrics.configure_sink(val)
+        elif name == "trace_sample":
+            self.metrics.configure_tracer(int(val))
         elif name == "eval_train":
             self.eval_train = int(val)
         elif name == "eval_group":

@@ -82,6 +82,13 @@ SERVE_KEYS = (
            "request batches at startup (serve_dtype != f32)"),
     K("serve_queue_depth", "int", lo=1,
       help="bounded request-queue depth (backpressure past it)"),
+    K("serve_sentinel", "int", lo=0, hi=1,
+      help="serve-side EWMA regression sentinels (p99 rise / QPS drop "
+           "/ queue-depth rise) over windowed serve_window records; "
+           "needs metrics_sink (doc/serve.md)"),
+    K("serve_sentinel_window", "float", lo=0.01,
+      help="seconds per sentinel observation window (the reporter "
+           "thread's cadence)"),
 )
 
 
@@ -97,8 +104,14 @@ class ServeConfig:
     clients: int = 4
     calib: int = 0
     queue_depth: int = 64
+    sentinel: int = 0
+    sentinel_window: float = 1.0
 
     def __post_init__(self):
+        if self.sentinel_window <= 0:
+            raise ValueError(
+                f"serve_sentinel_window = {self.sentinel_window}: must "
+                "be > 0 (seconds per observation window)")
         self.shapes = tuple(self.shapes)
         if not (self.shapes and all(s > 0 for s in self.shapes)
                 and list(self.shapes) == sorted(set(self.shapes))):
@@ -125,7 +138,10 @@ class ServeConfig:
                                  ("serve_dtype", "dtype", str),
                                  ("serve_clients", "clients", int),
                                  ("serve_calib", "calib", int),
-                                 ("serve_queue_depth", "queue_depth", int)):
+                                 ("serve_queue_depth", "queue_depth", int),
+                                 ("serve_sentinel", "sentinel", int),
+                                 ("serve_sentinel_window",
+                                  "sentinel_window", float)):
             if key in last:
                 kw[field] = conv(last[key])
         return cls(**kw)

@@ -42,6 +42,15 @@ class _Request:
     t0: float
     result: Optional[np.ndarray] = None
     error: Optional[BaseException] = None
+    # span tracing (monitor/spans.py): the sampled request's trace_id
+    # (None when unsampled or tracing is off), the submitting thread's
+    # name (its Perfetto track), and the stage boundary stamps the
+    # dispatcher records as the request moves through it — dequeue
+    # (queue_wait ends) and runner completion (respond begins)
+    trace_id: Optional[int] = None
+    tid: Optional[str] = None
+    t_deq: float = 0.0
+    t_served: float = 0.0
 
 
 class MicroBatcher:
@@ -73,13 +82,27 @@ class MicroBatcher:
         self._thread: Optional[threading.Thread] = None
         self._failed: Optional[BaseException] = None
         self._closing = False
-        # dispatch accounting for the ``serve`` record / bench report
+        # dispatch accounting for the ``serve`` record / bench report.
+        # Queue depth is sampled at BOTH ends — submit() (arrival) and
+        # the dispatcher (drain) — under _stats_lock: sampling only at
+        # dispatch time made bursts that arrived and fully drained
+        # between two dispatches invisible to depth_max
         self.n_requests = 0
         self.n_batches = 0
         self.rows_served = 0
         self.batch_hist: Dict[int, int] = {}
         self.depth_sum = 0
+        self.depth_samples = 0
         self.depth_max = 0
+        self._stats_lock = threading.Lock()
+        # windowed stats for the serve-side sentinels (opt-in: the
+        # reporter thread in task_serve flips track_window on and
+        # drains via window_stats(); off by default so the hot path
+        # pays nothing)
+        self.track_window = False
+        self._win_lock = threading.Lock()
+        self._win_lats: list = []
+        self._win_requests = 0
 
     # ------------------------------------------------------------- client
     def start(self) -> None:
@@ -98,8 +121,13 @@ class MicroBatcher:
         if self._closing:
             raise ServeClosed(f"batcher {self.name!r} is shut down")
         assert self._thread is not None, "call start() first"
+        tracer = self.metrics.tracer if self.metrics is not None else None
         req = _Request(data=np.asarray(x), event=threading.Event(),
                        t0=time.perf_counter())
+        if tracer is not None and tracer.enabled:
+            req.trace_id = tracer.new_trace()
+            if req.trace_id is not None:
+                req.tid = threading.current_thread().name
         # bounded put that re-checks the latch: a client must neither
         # block forever on a dead batcher's full queue nor enqueue
         # behind the shutdown drain (generation_put's discipline)
@@ -113,6 +141,11 @@ class MicroBatcher:
                 break
             except queue.Full:
                 continue
+        # arrival-side depth sample (the satellite fix): a burst that
+        # arrives and drains between two dispatches is visible only
+        # here — the dispatcher's sample runs after it already drained
+        # the queue into the open batch
+        self._observe_depth(self._q.qsize())
         # the latch can land between the check above and the put: the
         # dispatcher drains and dies, and our request sits in a queue
         # nobody reads.  Poll the thread while waiting — if it is gone,
@@ -123,10 +156,52 @@ class MicroBatcher:
                 self._drain(self._failed)
         if req.error is not None:
             raise req.error
+        latency = time.perf_counter() - req.t0
+        # t_served == 0 means the dispatcher skipped the span stamps
+        # (tracing toggled off between submit and dispatch): no chain
+        if req.trace_id is not None and tracer is not None \
+                and req.t_served > 0.0:
+            # respond: runner completion -> this client actually awake
+            # and returning; request: the whole submit->result wall,
+            # stamped from the SAME latency the histogram records so
+            # the span chain and serve_latency_sec agree exactly
+            tracer.emit("respond", req.t_served, req.t0 + latency,
+                        trace_id=req.trace_id, model=self.name)
+            tracer.emit("request", req.t0, req.t0 + latency,
+                        trace_id=req.trace_id, model=self.name)
         if self.metrics is not None:
-            self.metrics.observe("serve_latency_sec",
-                                 time.perf_counter() - req.t0)
+            self.metrics.observe("serve_latency_sec", latency)
+        if self.track_window:
+            with self._win_lock:
+                self._win_lats.append(latency)
+                self._win_requests += 1
         return req.result
+
+    def _observe_depth(self, depth: int) -> None:
+        with self._stats_lock:
+            self.depth_sum += depth
+            self.depth_samples += 1
+            if depth > self.depth_max:
+                self.depth_max = depth
+
+    def window_stats(self) -> Dict[str, Any]:
+        """Drain the current sentinel window: request count, latency
+        percentiles (ms), and the live queue depth.  The serve-side
+        sentinel reporter (main.task_serve) calls this once per
+        ``serve_sentinel_window`` seconds."""
+        with self._win_lock:
+            lats, self._win_lats = self._win_lats, []
+            n, self._win_requests = self._win_requests, 0
+        out: Dict[str, Any] = {"requests": n,
+                               "queue_depth": self._q.qsize()}
+        if lats:
+            from ..monitor.metrics import nearest_rank
+            lats.sort()
+            out.update(
+                p50_ms=round(nearest_rank(lats, 50) * 1e3, 3),
+                p95_ms=round(nearest_rank(lats, 95) * 1e3, 3),
+                p99_ms=round(nearest_rank(lats, 99) * 1e3, 3))
+        return out
 
     # --------------------------------------------------------- dispatcher
     def _loop(self) -> None:
@@ -138,6 +213,7 @@ class MicroBatcher:
                 first = self._q.get()
                 if first is None:
                     return
+                first.t_deq = time.perf_counter()
             batch = [first]
             rows = first.data.shape[0]
             stop = False
@@ -153,14 +229,14 @@ class MicroBatcher:
                 if r is None:       # shutdown sentinel mid-coalesce:
                     stop = True     # serve what we have, then exit
                     break
+                r.t_deq = time.perf_counter()
                 if rows + r.data.shape[0] > self.max_batch:
                     carry = r       # would overflow: opens the next batch
                     break
                 batch.append(r)
                 rows += r.data.shape[0]
             depth = self._q.qsize()
-            self.depth_sum += depth
-            self.depth_max = max(self.depth_max, depth)
+            self._observe_depth(depth)
             if self.metrics is not None:
                 self.metrics.set_gauge("serve_queue_depth", depth)
             if not self._run(batch, rows):
@@ -172,12 +248,43 @@ class MicroBatcher:
                 return
 
     def _run(self, batch, rows: int) -> bool:
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        riders = [r.trace_id for r in batch if r.trace_id is not None] \
+            if tracer is not None and tracer.enabled else []
         try:
+            t_disp = time.perf_counter()
+            if riders:
+                # close out each sampled rider's pre-dispatch stages:
+                # queue_wait (submit -> dequeued, on the rider's own
+                # track) and coalesce (dequeued -> this dispatch; a
+                # carry request's coalesce spans into the next batch)
+                for r in batch:
+                    if r.trace_id is None:
+                        continue
+                    tracer.emit("queue_wait", r.t0, r.t_deq,
+                                trace_id=r.trace_id, tid=r.tid,
+                                model=self.name)
+                    tracer.emit("coalesce", r.t_deq, t_disp,
+                                trace_id=r.trace_id, tid=r.tid,
+                                model=self.name)
             if len(batch) == 1:
-                out = self.runner(batch[0].data)
+                data = batch[0].data
             else:
-                out = self.runner(
-                    np.concatenate([r.data for r in batch], axis=0))
+                data = np.concatenate([r.data for r in batch], axis=0)
+            if riders:
+                # the engine's pad/device/unpad spans inherit the rider
+                # list through the thread-local link
+                with tracer.link(riders):
+                    out = self.runner(data)
+                t_done = time.perf_counter()
+                tracer.emit("dispatch", t_disp, t_done, riders=riders,
+                            rows=rows, requests=len(batch),
+                            model=self.name)
+                for r in batch:
+                    if r.trace_id is not None:
+                        r.t_served = t_done
+            else:
+                out = self.runner(data)
             self.n_batches += 1
             self.n_requests += len(batch)
             self.rows_served += rows
@@ -233,7 +340,8 @@ class MicroBatcher:
 
     @property
     def mean_depth(self) -> float:
-        return self.depth_sum / self.n_batches if self.n_batches else 0.0
+        return self.depth_sum / self.depth_samples \
+            if self.depth_samples else 0.0
 
     def stats(self) -> Dict[str, Any]:
         """Dispatch accounting for the ``serve`` JSONL record."""

@@ -244,18 +244,42 @@ class PredictEngine:
                 f"takes {self._in_shape}")
         t = self.trainer
         n = x.shape[0]
+        # span tracing (monitor/spans.py): pad/device/unpad decompose
+        # the batcher's dispatch span; rider trace_ids arrive through
+        # the tracer's thread-local link, so these rows need no
+        # plumbing.  Gated on the link itself, not just the tracer:
+        # a dispatch with no sampled rider must emit nothing, or
+        # trace_sample=100 would still write 3 records per dispatch
+        tracer = self.metrics.tracer
+        tracing = tracer is not None and tracer.enabled \
+            and tracer.linked() is not None
         outs, i = [], 0
         while i < n:
             take = min(n - i, self.shapes[-1])
             b = self.bucket_for(take)
+            t_pad0 = time.perf_counter() if tracing else 0.0
             chunk = x[i:i + take]
             if take < b:
                 chunk = np.concatenate(
                     [chunk, np.zeros((b - take,) + self._in_shape,
                                      np.float32)])
+            staged = self._stage(chunk)
+            if tracing:
+                t_dev0 = time.perf_counter()
+                tracer.emit("pad", t_pad0, t_dev0, bucket=b, rows=take)
             out = self._fns[b](self._params, self._scales, t.buffers,
-                               self._stage(chunk))
-            outs.append(np.asarray(out)[:take])
+                               staged)
+            # np.asarray is the D2H sync: the device span closes only
+            # once the result bytes are actually on the host
+            host = np.asarray(out)
+            if tracing:
+                t_unpad0 = time.perf_counter()
+                tracer.emit("device", t_dev0, t_unpad0, bucket=b,
+                            rows=take)
+            outs.append(host[:take])
+            if tracing:
+                tracer.emit("unpad", t_unpad0, time.perf_counter(),
+                            bucket=b, rows=take)
             i += take
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 

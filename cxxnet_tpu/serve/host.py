@@ -53,7 +53,13 @@ class ServeModel:
     def warmup(self) -> None:
         """Compile every bucket and start the dispatcher; after this,
         ``predict`` never traces (``engine.retraces`` stays 0)."""
-        self.engine.warmup()
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        if tracer is not None and tracer.enabled:
+            with tracer.span("serve_warmup", model=self.name,
+                             buckets=len(self.engine.shapes)):
+                self.engine.warmup()
+        else:
+            self.engine.warmup()
         self.batcher.start()
 
     def predict(self, x: np.ndarray) -> np.ndarray:

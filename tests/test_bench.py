@@ -96,11 +96,12 @@ def test_bench_opt_ab_mode():
 def test_bench_serve_mode():
     """--serve --tiny payload: one offered-QPS point over the serving
     subsystem with latency percentiles, the coalescer's batch-size
-    histogram, and the zero-retrace-after-warmup guarantee."""
+    histogram, the per-stage p99 decomposition (trace_sample), and the
+    zero-retrace-after-warmup guarantee."""
     import bench
     payload = bench.bench_serve(
         ["--tiny", "dev=cpu", "offered_qps=200", "duration=0.4",
-         "clients=4"])
+         "clients=4", "trace_sample=1"])
     assert payload["metric"] == "serve_p95_ms"
     assert payload["retraces"] == 0
     assert payload["warmup_sec"] > 0
@@ -113,6 +114,18 @@ def test_bench_serve_mode():
     assert sum(int(k) * v for k, v in pt["batch_hist"].items()) \
         == pt["requests"]
     assert payload["value"] == pt["p95_ms"]
+    # the per-stage request-path decomposition rode along: every
+    # traced request contributes to every top-level stage, and
+    # pad/device/unpad re-decompose dispatch (doc/monitor.md)
+    assert pt["traced_requests"] == pt["requests"]
+    stages = {s["stage"]: s for s in pt["stages"]}
+    for name in ("queue_wait", "coalesce", "dispatch", "pad", "device",
+                 "unpad", "respond"):
+        assert stages[name]["count"] == pt["requests"], name
+        assert stages[name]["p50_ms"] <= stages[name]["p99_ms"]
+    top_share = sum(stages[n]["share"] for n in
+                    ("queue_wait", "coalesce", "dispatch", "respond"))
+    assert 0.9 < top_share < 1.1  # the four stages tile a request
     # thread hygiene: the bench closed its batcher
     import threading
     assert not [t for t in threading.enumerate()

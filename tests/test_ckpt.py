@@ -175,6 +175,74 @@ def test_writer_failure_latches_and_reraises(tmp_path):
     assert ckptlib.validate_snapshot(str(tmp_path / "0001.ckpt")) is None
 
 
+def _traced_registry(path):
+    from cxxnet_tpu.monitor.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.configure_sink(f"jsonl:{path}")
+    reg.configure_tracer(1)
+    return reg
+
+
+def _span_records(path):
+    with open(path) as f:
+        return [r for r in map(json.loads, f) if r.get("kind") == "span"]
+
+
+def test_writer_spans_full_write(tmp_path):
+    """A committed async snapshot leaves the full writer-thread span
+    sequence: one ckpt_shard per shard, ckpt_manifest, ckpt_prune —
+    all on the writer thread's track (doc/monitor.md span schema)."""
+    sink = str(tmp_path / "m.jsonl")
+    reg = _traced_registry(sink)
+    w = AsyncCheckpointWriter(tracer=reg.tracer)
+    w.submit(str(tmp_path / "0001.ckpt"), _shards(), _meta(),
+             counter=1, keep=3)
+    w.close()
+    reg.close()
+    spans = _span_records(sink)
+    shards = [r for r in spans if r["span"] == "ckpt_shard"]
+    assert sorted(r["shard"] for r in shards) == ["opt", "params"]
+    assert all(r["tid"] == "cxxnet-ckpt-writer" for r in shards)
+    assert [r["span"] for r in spans if r["span"] == "ckpt_manifest"]
+    assert [r["span"] for r in spans if r["span"] == "ckpt_prune"]
+    # writer-thread timeline is ordered: shards before the manifest
+    manifest_us = next(r["us"] for r in spans
+                       if r["span"] == "ckpt_manifest")
+    assert all(r["us"] <= manifest_us for r in shards)
+
+
+def test_writer_spans_ride_fault_hook(tmp_path):
+    """The FAULT_HOOK crash test with tracing on: shards written before
+    the simulated kill have spans, the never-written manifest does not
+    — the span stream shows exactly how far the write got."""
+    class Boom(RuntimeError):
+        pass
+
+    def die_before_manifest(stage):
+        if stage == "manifest":
+            raise Boom("killed before manifest")
+
+    sink = str(tmp_path / "m.jsonl")
+    reg = _traced_registry(sink)
+    old = ckpt_writer.FAULT_HOOK
+    ckpt_writer.FAULT_HOOK = die_before_manifest
+    try:
+        w = AsyncCheckpointWriter(tracer=reg.tracer)
+        w.submit(str(tmp_path / "0001.ckpt"), _shards(), _meta(),
+                 counter=1, keep=3)
+        with pytest.raises(Boom):
+            w.close()
+    finally:
+        ckpt_writer.FAULT_HOOK = old
+    reg.close()
+    spans = _span_records(sink)
+    assert sorted(r["shard"] for r in spans
+                  if r["span"] == "ckpt_shard") == ["opt", "params"]
+    assert not [r for r in spans if r["span"] == "ckpt_manifest"]
+    # and the snapshot is exactly as partial as the spans say
+    assert ckptlib.validate_snapshot(str(tmp_path / "0001.ckpt")) is None
+
+
 # ------------------------------------------------ legacy single-file path
 
 def test_legacy_save_is_atomic(tmp_path, monkeypatch):

@@ -194,6 +194,57 @@ def test_batcher_stats_accounting():
         b.close()
 
 
+def test_batcher_depth_accounting_sees_bursts():
+    """Queue depth is sampled at submit() too (ISSUE 11 satellite): a
+    burst that arrives and fully drains between two dispatches used to
+    be invisible — the dispatcher's only sample runs AFTER it drained
+    the queue into the open batch, so depth_max read 0."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def runner(x):
+        entered.set()
+        gate.wait(5.0)
+        return x
+
+    b = MicroBatcher(runner, max_batch=32, max_wait_ms=1.0,
+                     queue_depth=64)
+    b.start()
+    outs = []
+
+    def client():
+        outs.append(b.submit(np.zeros((1, 2), np.float32)))
+
+    ths = [threading.Thread(target=client)]
+    ths[0].start()
+    assert entered.wait(5.0)  # dispatcher stuck inside the runner
+    # burst: five more requests pile up while no dispatch samples run
+    for k in range(5):
+        th = threading.Thread(target=client)
+        th.start()
+        ths.append(th)
+        deadline = time.perf_counter() + 5.0
+        while b._q.qsize() < k + 1 and time.perf_counter() < deadline:
+            time.sleep(0.001)
+    deadline = time.perf_counter() + 5.0
+    while b.depth_max < 5 and time.perf_counter() < deadline:
+        time.sleep(0.001)
+    depth_seen = b.depth_max
+    gate.set()
+    for th in ths:
+        th.join(timeout=10.0)
+    b.close()
+    assert len(outs) == 6
+    # the whole burst drained in the dispatch AFTER the stuck one, so
+    # dispatch-time sampling alone would have recorded depth_max = 0
+    assert depth_seen >= 5, depth_seen
+    s = b.stats()
+    assert s["queue_depth_max"] >= 5
+    assert 0 < s["queue_depth_mean"] <= s["queue_depth_max"]
+    # mean is over ALL samples (arrivals + dispatches), kept consistent
+    assert b.depth_samples >= b.n_requests + b.n_batches
+
+
 def test_batcher_latency_histogram():
     reg = MetricsRegistry()
     b = MicroBatcher(_echo_runner([]), max_batch=4, max_wait_ms=1.0,
@@ -524,6 +575,85 @@ def test_wrapper_serving_host_multi_model(tmp_path):
     assert not _serve_threads()
 
 
+# ----------------------------------------------------- span tracing e2e
+
+def test_serve_model_traced_span_chain(tmp_path):
+    """ISSUE 11 acceptance, real engine: with trace_sample > 0 every
+    request's stage durations (queue_wait + coalesce + dispatch +
+    respond) sum to within 5% of its recorded end-to-end wall, the
+    engine's pad/device/unpad decompose the dispatch, and the engine
+    still never retraces."""
+    import json
+
+    t = _trainer()
+    sink = str(tmp_path / "serve_spans.jsonl")
+    t.metrics.configure_sink(f"jsonl:{sink}")
+    t.metrics.configure_tracer(1)
+    sm = ServeModel(t, ServeConfig(shapes=(1, 4), max_wait_ms=10.0),
+                    name="traced")
+    sm.warmup()
+    try:
+        outs = {}
+
+        def client(i):
+            outs[i] = sm.predict(_rows(1, seed=i))
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        assert sm.retraces == 0
+        # flip tracing off mid-flight (same model, same sink): the hot
+        # path goes silent — zero NEW span records — while
+        # batched-vs-single parity and zero-retrace stay intact (the
+        # acceptance's off half, at zero extra compile cost)
+        t.metrics.configure_tracer(0)
+        n_spans_before = sum(1 for r in map(json.loads, open(sink))
+                             if r["kind"] == "span")
+        x = _rows(3, seed=11)
+        got = sm.predict(x)
+        alone = np.stack([sm.predict(x[i:i + 1])[0] for i in range(3)])
+        np.testing.assert_array_equal(got, alone)
+        assert sm.retraces == 0
+        assert sum(1 for r in map(json.loads, open(sink))
+                   if r["kind"] == "span") == n_spans_before
+    finally:
+        sm.close()
+        t.metrics.close()
+    spans = [r for r in map(json.loads, open(sink))
+             if r["kind"] == "span"]
+    per_req = {}
+    for r in spans:
+        if r.get("trace_id") is not None:
+            per_req.setdefault(r["trace_id"], {})[r["span"]] = r
+    dispatches = [r for r in spans if r["span"] == "dispatch"]
+    assert len(per_req) == 6
+    for tid, chain in per_req.items():
+        assert set(chain) == {"queue_wait", "coalesce", "respond",
+                              "request"}
+        mine = [d for d in dispatches if tid in d["riders"]]
+        assert len(mine) == 1
+        total = chain["request"]["dur_us"]
+        stages = (chain["queue_wait"]["dur_us"]
+                  + chain["coalesce"]["dur_us"] + mine[0]["dur_us"]
+                  + chain["respond"]["dur_us"])
+        assert abs(stages - total) / total < 0.05, (tid, stages, total)
+    # the engine decomposed each dispatch: pad/device/unpad nest inside
+    # it (same riders, contained interval, summing to ~the dispatch)
+    for d in dispatches:
+        sub = [r for r in spans
+               if r["span"] in ("pad", "device", "unpad")
+               and r.get("riders") == d["riders"]
+               and r["us"] >= d["us"]
+               and r["us"] + r["dur_us"] <= d["us"] + d["dur_us"] + 1]
+        assert {r["span"] for r in sub} == {"pad", "device", "unpad"}
+        assert sum(r["dur_us"] for r in sub) <= d["dur_us"] + 3
+    # warmup got its own span
+    assert [r for r in spans if r["span"] == "serve_warmup"]
+
+
 # ------------------------------------------------------------- CLI e2e
 
 @pytest.fixture
@@ -585,12 +715,17 @@ def test_cli_serve_end_to_end(trained_model):
     """task=serve under concurrent clients: output identical to
     task=pred, zero retraces, one latency record with percentiles plus
     the serve record with queue-depth gauges — the ISSUE 8 acceptance
-    run."""
+    run, now traced (trace_sample + serve_sentinel ride the same run:
+    the ISSUE 11 CLI acceptance, at zero extra test cost)."""
     import json
 
     from cxxnet_tpu.main import LearnTask
     tmp_path, net, model = trained_model
-    assert LearnTask().run([str(_serve_conf(tmp_path, net, model))]) == 0
+    conf = _serve_conf(
+        tmp_path, net, model,
+        extra="trace_sample = 4\nserve_sentinel = 1\n"
+              "serve_sentinel_window = 0.05\n")
+    assert LearnTask().run([str(conf)]) == 0
     out = np.loadtxt(tmp_path / "serve_out.txt")
     assert out.shape == (96,)
 
@@ -616,6 +751,38 @@ def test_cli_serve_end_to_end(trained_model):
     assert srv[0]["rows"] == 96
     assert srv[0]["queue_depth_max"] >= srv[0]["queue_depth_mean"] >= 0
     assert sum(int(k) * v for k, v in srv[0]["batch_hist"].items()) == 96
+
+    # --- ISSUE 11: the same run's span chains + sentinel windows ---
+    spans = [r for r in recs if r["kind"] == "span"]
+    per_req = {}
+    for r in spans:
+        if r.get("trace_id") is not None:
+            per_req.setdefault(r["trace_id"], {})[r["span"]] = r
+    assert len(per_req) == 24  # every 4th of 96 requests
+    dispatches = [r for r in spans if r["span"] == "dispatch"]
+    for tid, chain in per_req.items():
+        assert set(chain) == {"queue_wait", "coalesce", "respond",
+                              "request"}
+        mine = [d for d in dispatches if tid in d["riders"]]
+        assert len(mine) == 1
+        total = chain["request"]["dur_us"]
+        stages = (chain["queue_wait"]["dur_us"]
+                  + chain["coalesce"]["dur_us"] + mine[0]["dur_us"]
+                  + chain["respond"]["dur_us"])
+        assert abs(stages - total) / total < 0.05
+    wins = [r for r in recs if r["kind"] == "serve_window"]
+    assert wins and all(w["model"] == "default" for w in wins)
+    assert sum(w["requests"] for w in wins) == 96
+    # the read side parses what the run wrote
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import obsv
+    import spans2trace
+    rep = obsv.build_report(recs)
+    assert rep["serve_stages"]["requests"] == 24
+    assert rep["serve_windows"]["windows"] == len(wins)
+    trace = spans2trace.build_trace(spans)
+    assert len([e for e in trace["traceEvents"] if e["ph"] == "s"]) == 24
     assert not _serve_threads()
 
 

@@ -7,7 +7,9 @@
 #      tier-1's --continue-on-collection-errors;
 #   3. the run-report CLI over the checked-in metrics fixture — a schema
 #      drift between the sink's record kinds and tools/obsv.py's parser
-#      breaks loudly here, not in the middle of a perf triage.
+#      breaks loudly here, not in the middle of a perf triage;
+#   4. the span->Perfetto exporter over the same fixture — drift in the
+#      span record or tools/spans2trace.py fails the gate the same way.
 # Companion to tools/tier1.sh (the runtime gate); see doc/check.md.
 cd "$(dirname "$0")/.." || exit 1
 set -e
@@ -16,4 +18,7 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only \
     -p no:cacheprovider >/dev/null
 env JAX_PLATFORMS=cpu python tools/obsv.py tests/fixtures/run_report.jsonl \
     --json >/dev/null
+env JAX_PLATFORMS=cpu python tools/spans2trace.py \
+    tests/fixtures/run_report.jsonl | python -c \
+    'import json,sys; t=json.load(sys.stdin); assert t["traceEvents"]'
 echo "lint OK"

@@ -126,6 +126,26 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
               "batches", "mean_batch", "batch_hist", "queue_depth_mean",
               "queue_depth_max", "dtype", "shapes", "clients", "retraces",
               "quant_rel_err") if k in r} for r in by["serve"]]
+    if by.get("span"):
+        # request-path p99 decomposition (doc/monitor.md "Reading a
+        # p99 breakdown"): per-stage latency percentiles + share of
+        # total request wall, computed from the span records
+        from cxxnet_tpu.monitor.spans import stage_decomposition
+        dec = stage_decomposition(by["span"])
+        if dec["stages"]:
+            rep["serve_stages"] = dec
+    if by.get("serve_window"):
+        wins = by["serve_window"]
+        qps = [w["qps"] for w in wins if w.get("qps") is not None]
+        p99 = [w["p99_ms"] for w in wins if w.get("p99_ms") is not None]
+        rep["serve_windows"] = {
+            "windows": len(wins),
+            "qps_min": min(qps) if qps else None,
+            "qps_max": max(qps) if qps else None,
+            "p99_ms_max": max(p99) if p99 else None,
+            "queue_depth_max": max((w.get("queue_depth") or 0
+                                    for w in wins), default=0),
+        }
     if by.get("latency"):
         rep["latency"] = [
             {k: r.get(k) for k in
@@ -157,7 +177,8 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
         rep["anomalies"] = [
             {k: r.get(k) for k in
              ("metric", "direction", "value", "ewma", "rel_dev",
-              "round", "step") if k in r} for r in by["anomaly"]]
+              "round", "step", "window") if k in r}
+            for r in by["anomaly"]]
     rep["flights"] = len(by.get("flight", []))
     if by.get("nan"):
         rep["nonfinite_steps"] = len(by["nan"])
@@ -273,6 +294,26 @@ def render(rep: dict) -> str:
         if errs:
             out.append(f"quantization pairtest vs f32: max rel err "
                        f"{_fmt(max(errs), 4)}")
+    dec = rep.get("serve_stages")
+    if dec:
+        out.append("")
+        out.append(
+            f"request-path p99 decomposition ({dec['requests']} traced "
+            "request(s); share = fraction of total request wall — "
+            "pad/device/unpad nest inside dispatch):")
+        out.append(_table(
+            ["stage", "count", "p50_ms", "p95_ms", "p99_ms", "share"],
+            [[s["stage"], _fmt(s["count"]), _fmt(s["p50_ms"]),
+              _fmt(s["p95_ms"]), _fmt(s["p99_ms"]),
+              (f"{s['share']:.0%}" if s.get("share") is not None
+               else "-")] for s in dec["stages"]]))
+    sw = rep.get("serve_windows")
+    if sw:
+        out.append(
+            f"sentinel windows: {sw['windows']} (qps "
+            f"{_fmt(sw['qps_min'], 1)}..{_fmt(sw['qps_max'], 1)}, "
+            f"p99 max {_fmt(sw['p99_ms_max'])} ms, queue depth max "
+            f"{_fmt(sw['queue_depth_max'])})")
     lat = rep.get("latency")
     if lat:
         out.append("")
@@ -308,11 +349,12 @@ def render(rep: dict) -> str:
                    f"(flight dumps: {rep.get('flights', 0)})")
         out.append(_table(
             ["metric", "dir", "value", "ewma", "rel_dev", "round",
-             "step"],
+             "step", "win"],
             [[r.get("metric", "?"), r.get("direction", "?"),
               _fmt(r.get("value")), _fmt(r.get("ewma")),
               _fmt(r.get("rel_dev")), _fmt(r.get("round")),
-              _fmt(r.get("step"))] for r in anoms]))
+              _fmt(r.get("step")), _fmt(r.get("window"))]
+             for r in anoms]))
     elif rep.get("kinds", {}).get("step"):
         out.append("")
         out.append("anomalies: none")

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""spans2trace: export span records as Chrome trace-event JSON.
+
+The Perfetto leg of the request-path observatory (doc/monitor.md
+"Reading a p99 breakdown"): point it at the ``metrics_sink`` JSONL of a
+run traced with ``trace_sample = N`` and get a timeline loadable in
+Perfetto (ui.perfetto.dev) or ``chrome://tracing`` — one track per host
+thread (client threads show queue_wait → coalesce → … → respond, the
+dispatcher shows dispatch with pad/device/unpad nested, the checkpoint
+writer its shard/manifest/prune sequence), with flow arrows linking
+every request to the coalesced batch dispatch that served it.  Load it
+next to the device-trace windows (``prof = <dir>``) to see host and
+chip sides of the same incident.
+
+    python tools/spans2trace.py metrics.jsonl -o trace.json
+    python tools/spans2trace.py metrics.jsonl            # stdout
+
+Format: the Trace Event Format's JSON-object form —
+``{"traceEvents": [...]}`` with complete (``ph = X``) slices in µs,
+thread-name metadata (``ph = M``), and flow start/finish pairs
+(``ph = s`` / ``ph = f``, ``bp = e``) from each rider's coalesce slice
+to its dispatch slice.  The exporter is schema-coupled to the ``span``
+record (monitor/spans.py): tools/lint.sh runs it over the checked-in
+fixture, so drift in either breaks the lint gate, not a triage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+#: single-process export: every track hangs off one pid
+PID = 1
+
+
+def load_spans(path: str) -> List[dict]:
+    from obsv import load_records
+    from cxxnet_tpu.monitor.spans import span_records
+    return span_records(load_records(path))
+
+
+def build_trace(spans: List[dict]) -> dict:
+    """Span records -> one Trace Event Format object."""
+    events: List[dict] = []
+    tids: Dict[str, int] = {}
+
+    def tid_of(name: str) -> int:
+        if name not in tids:
+            tids[name] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name", "pid": PID,
+                           "tid": tids[name], "args": {"name": name}})
+        return tids[name]
+
+    # rider trace_id -> its coalesce span (the flow arrow's tail: the
+    # last thing that happened to the request before the batch closed)
+    coalesce_of: Dict[int, dict] = {}
+    for s in spans:
+        if s["span"] == "coalesce" and s.get("trace_id") is not None:
+            coalesce_of[s["trace_id"]] = s
+
+    for s in spans:
+        tid = tid_of(str(s.get("tid", "?")))
+        args = {k: v for k, v in s.items()
+                if k not in ("kind", "span", "us", "dur_us", "tid", "ts")}
+        events.append({"ph": "X", "name": s["span"], "cat": "host",
+                       "pid": PID, "tid": tid, "ts": s["us"],
+                       "dur": max(s["dur_us"], 1), "args": args})
+        if s["span"] == "dispatch" and s.get("riders"):
+            # flow arrows: every rider's coalesce slice -> this
+            # dispatch slice.  The start event must sit INSIDE a slice
+            # on the rider's track, so anchor it at the coalesce end.
+            for rid in s["riders"]:
+                c = coalesce_of.get(rid)
+                if c is None:
+                    continue
+                events.append({
+                    "ph": "s", "cat": "request", "name": "batched",
+                    "id": rid, "pid": PID,
+                    "tid": tid_of(str(c.get("tid", "?"))),
+                    "ts": c["us"] + max(c["dur_us"] - 1, 0)})
+                events.append({
+                    "ph": "f", "bp": "e", "cat": "request",
+                    "name": "batched", "id": rid, "pid": PID,
+                    "tid": tid, "ts": s["us"] + 1})
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"exporter": "cxxnet_tpu tools/spans2trace.py",
+                          "n_spans": len(spans)}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="export span records as Chrome trace-event JSON "
+                    "(Perfetto / chrome://tracing)")
+    ap.add_argument("jsonl", help="metrics_sink JSONL file")
+    ap.add_argument("-o", "--out", default="",
+                    help="output .json path (default: stdout)")
+    args = ap.parse_args(argv)
+    try:
+        spans = load_spans(args.jsonl)
+    except OSError as e:
+        print(f"spans2trace: {e}", file=sys.stderr)
+        return 1
+    if not spans:
+        print(f"spans2trace: no span records in {args.jsonl} "
+              "(was the run traced? trace_sample = N + metrics_sink)",
+              file=sys.stderr)
+        return 1
+    trace = build_trace(spans)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+        n = len(trace["traceEvents"])
+        print(f"spans2trace: wrote {n} events from {len(spans)} spans "
+              f"to {args.out}", file=sys.stderr)
+    else:
+        json.dump(trace, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
