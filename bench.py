@@ -660,6 +660,28 @@ def _comm_axis_shares(rep) -> dict:
     return {ax: 0.0 for ax in out}
 
 
+def _hbm_point(t) -> dict:
+    """Per-arm memory bytes for the A/B payloads (doc/memory.md).
+    Primary: the compiled step's temp/args bytes from
+    ``step_memory_stats`` (one extra AOT compile, cached per trainer)
+    — deterministic PER ARM, which is what an A/B needs.  The measured
+    device high-water (``hbm_peak_bytes``) rides along where the
+    backend reports it, but it is the allocator's PROCESS-lifetime
+    peak: sequential arms in one process inherit the heaviest earlier
+    arm's value, so compare arms on the exec_* columns.  BENCH_r06
+    A/Bs read this to show memory wins, not just ms/step."""
+    out = {}
+    try:
+        stats = t.step_memory_stats()
+        if stats:
+            out.update(exec_temp_bytes=stats["temp_bytes"],
+                       exec_args_bytes=stats["args_bytes"])
+        out.update(t.memory_gauges())
+    except Exception as e:  # memory telemetry must never break the A/B
+        print(f"bench: hbm point failed: {e}", file=sys.stderr)
+    return out
+
+
 def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
               make_data, scan_len, extra=(), bucket_mb="4",
               mesh_str=None):
@@ -706,6 +728,7 @@ def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
     point = {"devices": n, "mesh": mesh_str,
              "examples_per_sec_per_chip": round(per_chip, 1),
              "step_sec": round(dt, 5)}
+    point.update(_hbm_point(t))
     # comm/compute split from a traced dispatch (the number the
     # reference only claimed qualitatively; collective classification in
     # monitor/trace.py).  CPU-runtime traces may carry no XLA-op lines —
@@ -1251,6 +1274,7 @@ def bench_opt_ab(argv=None) -> dict:
             np.asarray(pending)
             entry = {"step_ms": round(sorted(ms)[1], 3),
                      "opts": dict(OPT_AB_ARMS[arm])}
+            entry.update(_hbm_point(t))
             try:
                 dev_ms = _traced_device_step_ms(
                     t, toks, labels, scan_len, "/tmp/bench_opt_ab")

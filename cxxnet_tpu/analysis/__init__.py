@@ -26,6 +26,15 @@ def run_check(cfg, path: str = "", trace: bool = True
     from . import conflint
     findings = conflint.lint_pairs(cfg, path=path)
     has_net = any(k.startswith("layer[") for k, _ in cfg)
+    if dict(cfg).get("mem_check", "0") == "1" \
+            and (not trace or not has_net):
+        findings.append(Finding(
+            "warn", "mem_check",
+            "the OOM pre-flight needs the traced-graph pass (it models "
+            "the built net); " + ("--no-trace disables it"
+                                  if not trace else
+                                  "this config has no netconfig block"),
+            scope="mem"))
     if not trace:
         pass
     elif not has_net:
@@ -75,8 +84,21 @@ def _trace_findings(cfg) -> List[Finding]:
             # directly; the build chatter (net description) is lint noise.
             # A mesh config needs its axis product in CPU devices — force
             # the host platform count (no-op once a backend initialized)
-            # and skip the trace rather than erroring when short
+            # and skip the trace rather than erroring when short.  A
+            # multi-device dev= WITHOUT a mesh= key counts too: the
+            # runtime auto-builds a data:N mesh over it, and the memory
+            # pre-flight must see the same per-device shards (modeling
+            # a tpu:0-7 job on one emulated chip would charge 8 chips'
+            # activations to one HBM and spuriously fail the check)
             need = net.mesh_spec.size if net.mesh_spec is not None else 1
+            try:
+                from ..parallel.mesh import parse_device_spec
+                ids = parse_device_spec(
+                    dict(cfg).get("dev", "cpu"))["ids"]
+                if ids:
+                    need = max(need, len(ids))
+            except ValueError:
+                pass  # an unparseable dev= fails at init_model below
             if need > 1:
                 _ensure_host_devices(need)
                 import jax
@@ -89,11 +111,23 @@ def _trace_findings(cfg) -> List[Finding]:
                 except RuntimeError:
                     n_vis = len(jax.devices())
                 if n_vis < need:
-                    return [F(
+                    skipped = [F(
                         "info", "mesh",
                         f"traced-graph lint skipped: mesh needs {need} "
                         f"devices, {n_vis} visible on the host platform "
                         "(config lint above still ran)", scope="jaxpr")]
+                    if dict(cfg).get("mem_check", "0") == "1":
+                        # a CI gate relying on the pre-flight must not
+                        # read exit 0 as "it fits" when the check never
+                        # ran — and big-mesh configs are exactly the
+                        # ones most likely to OOM
+                        skipped.append(F(
+                            "warn", "mem_check",
+                            "the OOM pre-flight did NOT run: it needs "
+                            "the traced-graph pass, which this host "
+                            f"cannot emulate ({need} mesh devices, "
+                            f"{n_vis} visible)", scope="mem"))
+                    return skipped
                 net.set_param("dev", f"cpu:0-{need - 1}")
             else:
                 net.set_param("dev", "cpu")
@@ -106,11 +140,23 @@ def _trace_findings(cfg) -> List[Finding]:
                       f"build the train step on cpu ({e})", scope="jaxpr")]
         finally:
             mlog.set_silent(1 if was_silent else 0)
+        out: List[Finding] = []
         try:
-            return jaxpr_lint.lint_trainer(net)
+            out.extend(jaxpr_lint.lint_trainer(net))
         except Exception as e:  # noqa: BLE001 — lint must not crash check
-            return [F("warn", "", f"traced-graph lint failed: {e}",
-                      scope="jaxpr")]
+            out.append(F("warn", "", f"traced-graph lint failed: {e}",
+                        scope="jaxpr"))
+        # OOM pre-flight (mem_check = 1, doc/memory.md): the analytic
+        # memory model vs the target chip's HBM, on the SAME built
+        # trainer — an over-budget config fails here, before a
+        # compile-and-train cycle is spent discovering it on chip
+        try:
+            from . import memmodel
+            out.extend(memmodel.preflight(net, cfg))
+        except Exception as e:  # noqa: BLE001 — lint must not crash check
+            out.append(F("warn", "mem_check",
+                         f"memory pre-flight failed: {e}", scope="mem"))
+        return out
     finally:
         for k, v in engine_snap.items():
             setattr(engine.opts, k, v)

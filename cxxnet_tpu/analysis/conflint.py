@@ -373,6 +373,42 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
     _serve_rules(last, task, add)
     _ckpt_rules(last, task, monitor, add)
     _text_rules(pairs, last, layer_types, add)
+    _mem_rules(last, task, add)
+
+
+def _mem_rules(last: Dict[str, str], task: str, add) -> None:
+    """Cross-key rules for the OOM pre-flight (doc/memory.md).  The
+    pre-flight itself runs inside ``task=check``'s traced-graph pass
+    (analysis/memmodel.py); these rules catch configurations where it
+    silently models the wrong thing or nothing at all."""
+    mem_check = last.get("mem_check", "0") == "1"
+    if mem_check:
+        if task not in ("train", "finetune"):
+            add(Finding("warn", "mem_check",
+                        f"the pre-flight models the TRAIN step's memory; "
+                        f"task = {task} serves/predicts with a different "
+                        "(smaller) footprint — the estimate does not "
+                        "describe this run"))
+        if _as_int(last, "remat", 0) > 1:
+            add(Finding("info", "mem_check",
+                        "remat > 1: the pre-flight assumes only "
+                        "segment-boundary activations persist; XLA may "
+                        "keep more, so treat mem_margin_pct as softer "
+                        "(doc/memory.md)"))
+        from .costmodel import resolve_chip
+        sel = last.get("mem_chip", "") or last.get("dev", "")
+        if resolve_chip(sel) is None:
+            add(Finding("warn", "mem_chip",
+                        f"mem_check = 1 but mem_chip/dev = {sel!r} names "
+                        "no known chip; the pre-flight has no HBM "
+                        "capacity to check against (set mem_chip, e.g. "
+                        "v5e)"))
+    else:
+        for k in ("mem_margin_pct", "mem_chip"):
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect without mem_check = 1"))
+                break
 
 
 def _ckpt_rules(last: Dict[str, str], task: str, monitor: int, add) -> None:

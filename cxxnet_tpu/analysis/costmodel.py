@@ -38,6 +38,14 @@ PEAK_BW = {
     "TPU v5p": 2765e9, "TPU v6e": 1640e9,
 }
 
+#: HBM capacity per chip (bytes) — the denominator of the OOM
+#: pre-flight (analysis/memmodel.py) and the mem_profile capacity
+#: column (doc/memory.md)
+HBM_BYTES = {
+    "TPU v5 lite": 16e9, "TPU v5e": 16e9, "TPU v4": 32e9,
+    "TPU v5p": 95e9, "TPU v6e": 32e9,
+}
+
 TRAIN_FLOP_MULT = 3.0  # fwd + dgrad + wgrad, the bench.py convention
 
 
@@ -51,6 +59,33 @@ def peak_flops(device_kind: str) -> Optional[float]:
 def peak_bw(device_kind: str) -> Optional[float]:
     return next((v for k, v in PEAK_BW.items() if k in device_kind),
                 None)
+
+
+def hbm_bytes(device_kind: str) -> Optional[float]:
+    """Chip HBM capacity, or None for unknown kinds (CPU hosts)."""
+    return next((v for k, v in HBM_BYTES.items() if k in device_kind),
+                None)
+
+
+def resolve_chip(selector: str) -> Optional[str]:
+    """Resolve a chip selector (``v5e``, ``tpu v4``, a full
+    ``device_kind`` string...) to its canonical HBM-table key, or None.
+    Case-insensitive.  A selector resolves only when it is unambiguous:
+    a full table key, a device_kind string CONTAINING one, or the
+    key's short alias (``v5e`` for "TPU v5e").  Anything matching
+    zero or several keys — ``v5``, ``tpu``, a typo — returns None so
+    the caller warns instead of silently checking against the wrong
+    chip's capacity."""
+    s = " ".join(selector.strip().lower().split())
+    if not s:
+        return None
+    hits = set()
+    for k in HBM_BYTES:
+        kl = k.lower()
+        alias = kl[len("tpu "):] if kl.startswith("tpu ") else kl
+        if kl in s or s in (kl, alias, "tpu " + alias):
+            hits.add(k)
+    return hits.pop() if len(hits) == 1 else None
 
 
 def _elems(shape) -> float:

@@ -1,0 +1,260 @@
+"""Analytic per-layer memory model + the OOM pre-flight.
+
+The byte-side twin of :mod:`analysis.costmodel` (which models time):
+per-layer parameter / gradient / optimizer-state / activation byte
+formulas over a BUILT :class:`~cxxnet_tpu.nnet.trainer.NetTrainer`,
+keyed by the same ``conn_scope_name`` strings the whole observatory
+joins on.  Two consumers:
+
+* the ``mem_profile`` record (monitor/memory.py) carries each row's
+  ``model_bytes`` / ``model_x`` the same way ``layer_profile`` carries
+  roofline columns — measured-vs-model distance per layer;
+* ``task=check`` runs :func:`preflight` against the target chip's HBM
+  capacity (costmodel.HBM_BYTES) and errors when the estimated peak
+  exceeds it (warns inside ``mem_margin_pct``), with remediation in
+  the finding text (doc/memory.md).
+
+Accounting is PER DEVICE: parameter/optimizer leaves are measured
+through their actual shardings (a ZeRO-sharded or model-sharded leaf
+counts its shard, not the logical array — never double-counted), and
+activations divide the global batch by the mesh's data axis.  The model
+is deliberately coarse on the same terms as the cost model: a ranking
+aid and a conservative pre-flight ceiling, not a calibrated simulator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from . import costmodel
+from .schema import Finding
+
+#: unmodeled-temp slack the pre-flight adds on top of the analytic sum
+#: (fusion scratch, collective staging, allocator fragmentation)
+WORKSPACE_FRAC = 0.10
+
+
+def leaf_device_bytes(leaf) -> int:
+    """Per-device bytes of one placed array: the shard this device
+    holds (sharding-aware), not the logical array."""
+    try:
+        shape = leaf.sharding.shard_shape(leaf.shape)
+    except Exception:  # noqa: BLE001 — unplaced / numpy leaf
+        shape = getattr(leaf, "shape", ())
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * leaf.dtype.itemsize
+
+
+def tree_device_bytes(tree) -> int:
+    """Per-device bytes of a (possibly nested) param tree — the ONE
+    shard-aware accounting rule (serve footprints import it too)."""
+    total = 0
+    for v in tree.values():
+        total += tree_device_bytes(v) if isinstance(v, dict) \
+            else leaf_device_bytes(v)
+    return total
+
+
+def param_rows(trainer) -> Dict[str, Dict[str, int]]:
+    """scope -> ``{param_bytes, opt_bytes}``, per device, from the
+    trainer's ACTUAL placed trees (shardings included).  Shared
+    connections contribute nothing — their parameters alias the
+    primary's (the not-double-counted contract)."""
+    from ..layers.base import conn_scope_name
+    out: Dict[str, Dict[str, int]] = {}
+    for i, conn in enumerate(trainer.net.connections):
+        if not conn.owns_params or conn.param_key not in trainer.params:
+            continue
+        out[conn_scope_name(i, conn)] = {
+            "param_bytes": tree_device_bytes(
+                trainer.params[conn.param_key]),
+            "opt_bytes": tree_device_bytes(
+                trainer.opt_state[conn.param_key]),
+        }
+    return out
+
+
+def _data_shards(trainer) -> int:
+    try:
+        return int(trainer.mesh.shape.get("data", 1))
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def layer_mem(trainer) -> Dict[str, Dict[str, int]]:
+    """scope -> per-device ``{param_bytes, grad_bytes, opt_bytes,
+    act_bytes}`` for EVERY connection (shared ones carry activations
+    but no params).  ``act_bytes`` is the connection's output
+    activation — what it costs while live between forward and backward;
+    remat/batch_split residency corrections happen at the totals level
+    (:func:`totals`), where they are properties of the schedule, not of
+    one layer."""
+    import jax.numpy as jnp
+    from ..layers.base import conn_scope_name
+    itemsize = jnp.dtype(trainer.dtype).itemsize
+    ndata = _data_shards(trainer)
+    prows = param_rows(trainer)
+    out: Dict[str, Dict[str, int]] = {}
+    for i, conn in enumerate(trainer.net.connections):
+        scope = conn_scope_name(i, conn)
+        act = 0
+        for nid in conn.nindex_out:
+            shp = trainer.net.node_shapes[nid]
+            n = 1
+            for d in shp:
+                n *= int(d)
+            act += (n // max(ndata, 1)) * itemsize
+        pr = prows.get(scope, {})
+        pbytes = int(pr.get("param_bytes", 0))
+        out[scope] = {
+            "param_bytes": pbytes,
+            # gradients materialize in the parameter dtype during
+            # backward — transient, but live together near the apply
+            "grad_bytes": pbytes,
+            "opt_bytes": int(pr.get("opt_bytes", 0)),
+            "act_bytes": act,
+        }
+    return out
+
+
+def totals(trainer, per_layer: Optional[Dict[str, Dict[str, int]]] = None
+           ) -> Dict[str, int]:
+    """Per-device byte totals + the estimated peak the pre-flight
+    checks.  Schedule-aware corrections:
+
+    * ``remat = K``: only segment-boundary activations persist across
+      the backward; within a segment one recompute window is live at a
+      time — held = each segment's LAST activation, live = the largest
+      segment's sum;
+    * ``batch_split = K``: activations divide by K (one sub-batch chain
+      live at a time);
+    * ``update_period > 1``: the gradient accumulator persists between
+      micro-steps (parameter-shaped; halved by
+      ``dp_reduce_dtype = bf16`` when parameters are f32, the
+      remediation the pre-flight suggests).
+    """
+    per_layer = per_layer or layer_mem(trainer)
+    acts = [v["act_bytes"] for v in per_layer.values()]
+    param = sum(v["param_bytes"] for v in per_layer.values())
+    grad = sum(v["grad_bytes"] for v in per_layer.values())
+    opt = sum(v["opt_bytes"] for v in per_layer.values())
+    act = sum(acts)
+    remat = int(getattr(trainer, "remat", 0) or 0)
+    if remat > 1 and len(acts) >= remat:
+        k = remat
+        chunk = max(len(acts) // k, 1)
+        segs = [acts[j: j + chunk] for j in range(0, len(acts), chunk)]
+        held = sum(s[-1] for s in segs if s)
+        live = max(sum(s) for s in segs)
+        # capped: on shallow nets boundary + window can exceed the plain
+        # sum (the boundary of the live window counts twice) — remat
+        # never costs MORE than keeping everything in this model
+        act = min(held + live, act)
+    bsplit = int(getattr(trainer, "batch_split", 1) or 1)
+    if bsplit > 1:
+        act = act // bsplit
+    acc = 0
+    if int(getattr(trainer, "update_period", 1)) > 1:
+        acc = param
+        from .. import engine
+        if getattr(engine.opts, "dp_reduce_dtype", "f32") == "bf16":
+            acc = acc // 2
+    buffers = tree_device_bytes(getattr(trainer, "buffers", {}) or {})
+    est = param + grad + opt + acc + act + buffers
+    est += int(est * WORKSPACE_FRAC)
+    return {"param_bytes": param, "grad_bytes": grad,
+            "opt_bytes": opt, "acc_bytes": acc, "act_bytes": act,
+            "buffer_bytes": buffers, "est_peak_bytes": est}
+
+
+def _fmt_gb(b: float) -> str:
+    return f"{b / 1e9:.2f} GB"
+
+
+def _remediations(trainer, tot: Dict[str, int]) -> List[str]:
+    """Ordered did-you-mean-style knob suggestions, biggest modeled
+    saving first (doc/memory.md 'When the pre-flight fires')."""
+    out: List[Tuple[int, str]] = []
+    act, opt, acc = tot["act_bytes"], tot["opt_bytes"], tot["acc_bytes"]
+    if int(getattr(trainer, "remat", 0) or 0) <= 1 and act:
+        out.append((act // 2, "remat = 2..4 (checkpoint activations; "
+                    f"~{_fmt_gb(act / 2)} off)"))
+    if int(getattr(trainer, "batch_split", 1) or 1) <= 1 and act:
+        out.append((act // 2, "batch_split = 2 (halve live "
+                    f"activations; ~{_fmt_gb(act / 2)} off)"))
+    if not int(getattr(trainer, "shard_opt_state", 0) or 0) \
+            and _data_shards(trainer) > 1 and opt:
+        nd = _data_shards(trainer)
+        save = opt - opt // nd
+        out.append((save, "shard_opt_state = 1 (ZeRO over the data "
+                    f"axis; ~{_fmt_gb(save)} off)"))
+    if acc:
+        from .. import engine
+        if getattr(engine.opts, "dp_reduce_dtype", "f32") != "bf16":
+            out.append((acc // 2, "dp_reduce_dtype = bf16 (halve the "
+                        f"grad accumulator; ~{_fmt_gb(acc / 2)} off)"))
+    out.sort(key=lambda kv: -kv[0])
+    return [s for _, s in out]
+
+
+def preflight(trainer, cfg_pairs) -> List[Finding]:
+    """The OOM pre-flight behind ``task=check`` (``mem_check = 1``,
+    doc/memory.md): run the analytic model against the target chip's
+    HBM and report BEFORE a compile-and-train cycle is spent.
+
+    Chip resolution: ``mem_chip`` (``v5e``, ``tpu v4``, a full
+    device_kind), else the config's ``dev`` string when it names a
+    known chip.  An unresolvable chip returns no findings here — the
+    conflint rule (``_mem_rules``) already warns about it on every
+    check run, traced or not, and one message beats two.  Estimated
+    peak over capacity is an ERROR; within ``mem_margin_pct`` (default
+    10) of capacity is a WARNING; otherwise one info finding records
+    the headroom.  Remediation knobs ride in the finding text, largest
+    modeled saving first."""
+    last = dict(cfg_pairs)
+    if last.get("mem_check", "0") != "1":
+        return []
+    sel = last.get("mem_chip", "") or last.get("dev", "")
+    chip = costmodel.resolve_chip(sel)
+    if chip is None:
+        return []
+    cap = costmodel.HBM_BYTES[chip]
+    try:
+        margin = float(last.get("mem_margin_pct", "10"))
+    except ValueError:
+        margin = 10.0
+    tot = totals(trainer)
+    est = tot["est_peak_bytes"]
+    parts = (f"params {_fmt_gb(tot['param_bytes'])} + grads "
+             f"{_fmt_gb(tot['grad_bytes'])} + opt "
+             f"{_fmt_gb(tot['opt_bytes'])} + acts "
+             f"{_fmt_gb(tot['act_bytes'])}"
+             + (f" + acc {_fmt_gb(tot['acc_bytes'])}"
+                if tot["acc_bytes"] else "")
+             + f" + {int(WORKSPACE_FRAC * 100)}% workspace")
+    findings: List[Finding] = []
+    if est > cap:
+        fix = _remediations(trainer, tot)
+        msg = (f"estimated peak HBM {_fmt_gb(est)} exceeds {chip} "
+               f"capacity {_fmt_gb(cap)} per device ({parts})")
+        if fix:
+            msg += "; did you mean: " + "; ".join(fix)
+        findings.append(Finding("error", "mem_check", msg, scope="mem"))
+    elif est > cap * (1.0 - margin / 100.0):
+        fix = _remediations(trainer, tot)
+        findings.append(Finding(
+            "warn", "mem_check",
+            f"estimated peak HBM {_fmt_gb(est)} is within "
+            f"{margin:g}% of {chip} capacity {_fmt_gb(cap)} "
+            f"({parts}); consider: " + "; ".join(fix[:2]), scope="mem"))
+    else:
+        findings.append(Finding(
+            "info", "mem_check",
+            f"estimated peak HBM {_fmt_gb(est)} of {chip} "
+            f"{_fmt_gb(cap)} ({est / cap:.0%} full; {parts})",
+            scope="mem"))
+    # the remat-softens-the-estimate caveat is the conflint rule's job
+    # (_mem_rules fires it with or without the traced pass)
+    return findings

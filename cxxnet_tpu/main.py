@@ -69,6 +69,17 @@ TASK_KEYS = (
       help="flight-recorder depth: last K step records dumped on an "
            "anomaly or TrainingDiverged"),
     K("test_on_server", "int", lo=0, hi=1),
+    # OOM pre-flight (analysis/memmodel.py, doc/memory.md): task=check
+    # runs the analytic memory model against the target chip's HBM
+    K("mem_check", "int", lo=0, hi=1,
+      help="task=check: error when the estimated peak HBM exceeds the "
+           "target chip's capacity (warn inside mem_margin_pct)"),
+    K("mem_margin_pct", "float", lo=0, hi=90,
+      help="pre-flight warning margin: warn when the estimate lands "
+           "within this % of capacity (default 10)"),
+    K("mem_chip", "str",
+      help="pre-flight HBM capacity selector (v4/v5e/v5p/v6e or a "
+           "full device_kind); defaults to dev= when it names a chip"),
     # the runtime deliberately tolerates unknown spellings (treated as
     # binary, with a warning) — soft keeps the lint at warn severity
     K("output_format", "enum", choices=("txt", "bin"), soft=True),
@@ -148,9 +159,12 @@ class LearnTask:
         self._resume_iter_state = None
         self._resume_sentinel_state = None
         self._warned_iter_capture = False
-        # instruction->scope join, cached like trainer._step_hlo_cache:
+        # instruction->scope join, cached like trainer._step_aot_cache:
         # recurring prof_every windows must not re-scan the HLO text
         self._op_scopes_cache = None
+        # the mem_profile table (monitor/memory.py) is the executable's
+        # static truth — built once per trainer, re-emitted per window
+        self._mem_profile_cache = None
         # wall seconds of the first train dispatch (jit trace + compile
         # happen synchronously inside it); None until it ran
         self.compile_sec: Optional[float] = None
@@ -496,6 +510,7 @@ class LearnTask:
                 self._sentinel_bank.observe_trace(
                     dict(rep, round=self.start_counter - 1))
             self._emit_layer_profile(planes, steps)
+            self._emit_mem_profile()
 
     def _emit_layer_profile(self, planes, steps: int) -> None:
         """Join the window's per-op device times against the stamped
@@ -534,6 +549,81 @@ class LearnTask:
                     f"({table['coverage'] * 100:.0f}%); top: {top}")
         except Exception as e:  # noqa: BLE001 — telemetry only
             mlog.warn(f"layer attribution failed: {e}")
+
+    def _emit_mem_profile(self) -> None:
+        """The memory leg of the observatory (doc/memory.md): join the
+        compiled step's buffer liveness (monitor/memory.py) against the
+        trainer's placed param/opt trees and the analytic memory model
+        (analysis/memmodel.py); emit one ``mem_profile`` record per
+        closed profile window.  The HLO parse and the liveness walk are
+        cached per trainer — recurring ``prof_every`` windows re-scan
+        nothing — and the whole path rides the same cached AOT compile
+        ``step_hlo_text`` already paid for layer attribution."""
+        net = self.net
+        metrics = net.metrics
+        try:
+            table = self._mem_profile_cache \
+                if getattr(self, "_mem_profile_cache", None) is not None \
+                else self._build_mem_profile()
+            if table is None:
+                return
+            self._mem_profile_cache = table
+            # measured gauges land fresh each window (the cached table
+            # is the executable's static truth; the gauges are not)
+            gauges = net.memory_gauges()
+            table = dict(table, **gauges)
+            metrics.emit("mem_profile", round=self.start_counter - 1,
+                         **table)
+            if not mlog.is_silent() and table["rows"]:
+                top = ", ".join(
+                    f"{r['layer']} {r['total_bytes'] / 1e6:.2f} MB"
+                    for r in table["rows"][:3])
+                mlog.info(
+                    f"mem_profile: peak live "
+                    f"{table['peak_live_bytes'] / 1e6:.2f} MB temps at "
+                    f"{table['peak_frac']:.0%} of the step; top: {top}")
+            # satellite (doc/monitor.md): on backends without
+            # memory_stats() the HBM sentinel can never see a gauge —
+            # the executable-derived temp total is its fallback
+            # BASELINE.  The cached value is constant per executable
+            # (so it cannot fire mid-run by itself); its worth is the
+            # series it lands in the sink and the EWMA it seeds, which
+            # a RESUMED run's first differing executable is judged
+            # against (ckpt carries sentinel state)
+            bank = self._sentinel_bank
+            if bank is not None and not gauges:
+                exec_stats = table.get("exec") or {}
+                fb = exec_stats.get("temp_bytes") \
+                    or table["peak_live_bytes"]
+                if fb:
+                    bank.observe_round({"round": self.start_counter - 1,
+                                        "hbm_peak_bytes": int(fb)})
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            mlog.warn(f"memory attribution failed: {e}")
+
+    def _build_mem_profile(self):
+        from .analysis import costmodel, memmodel
+        from .monitor import memory as memlib
+        net = self.net
+        hlo = net.step_hlo_text()
+        if not hlo:
+            return None
+        model = memmodel.layer_mem(net)
+        table = memlib.mem_table(
+            hlo, net.layer_scopes(),
+            exec_stats=net.step_memory_stats(),
+            param_rows=memmodel.param_rows(net),
+            # the per-row model join compares like with like: the
+            # measured total is param+opt+live-act, so the transient
+            # grad term stays out of the per-row model_bytes
+            model_rows={s: {k: v for k, v in r.items()
+                            if k != "grad_bytes"}
+                        for s, r in model.items()})
+        table["model"] = memmodel.totals(net, model)
+        cap = costmodel.hbm_bytes(net.devices[0].device_kind)
+        if cap:
+            table["hbm_capacity_bytes"] = int(cap)
+        return table
 
     # ---------------------------------------------------------------- tasks
     def _ckpt_extra_state(self, capture_iter: bool = True) -> dict:
@@ -748,6 +838,20 @@ class LearnTask:
                 # instead of re-warming from scratch
                 self._sentinel_bank.set_state(self._resume_sentinel_state)
                 self._resume_sentinel_state = None
+            if not self.net.memory_gauges():
+                # the HBM watcher would silently never arm here (no
+                # memory_stats() on this backend, e.g. CPU CI) — say so
+                # once.  With prof = <dir> the mem_profile path feeds
+                # it the compiled step's temp bytes instead: a static
+                # baseline series (one value per executable), not a
+                # live high-water — it documents the footprint and
+                # seeds a resumable EWMA, it cannot catch runtime
+                # allocator growth
+                mlog.warn(
+                    "sentinel: this backend reports no memory_stats(); "
+                    "the HBM watcher gets only the executable-derived "
+                    "temp-byte baseline from profile windows (set "
+                    "prof = <dir>), not a live high-water")
         elif self.sentinel:
             # every sentinel output goes to the sink; armed without one
             # it would only add a per-print-step D2H loss sync (lint
@@ -1262,6 +1366,19 @@ class LearnTask:
         sm.warmup()
         mlog.info(f"serve: warmup compiled in {sm.engine.warmup_sec:.1f} "
                   "sec")
+        # per-model executable footprint (doc/memory.md): what this
+        # model costs the device pool resident — the serve record
+        # carries it so a multi-model host can pack against capacity
+        # instead of packing blind
+        footprint = sm.footprint()
+        if footprint:
+            metrics.set_gauge("serve_footprint_bytes",
+                              footprint["total_bytes"])
+            mlog.info(
+                f"serve: model footprint "
+                f"{footprint['total_bytes'] / 1e6:.1f} MB/device "
+                f"(weights {footprint['weight_bytes'] / 1e6:.1f} MB + "
+                f"{footprint['buckets']} bucket executable(s))")
         # quantization pairtest on real request data (doc/serve.md):
         # the measured side of the declared SERVE_TOL envelope, run on
         # the first serve_calib batches before serving starts
@@ -1449,6 +1566,7 @@ class LearnTask:
                     shapes=list(cfg.shapes), clients=cfg.clients,
                     retraces=sm.retraces,
                     **stats,
+                    **({"footprint": footprint} if footprint else {}),
                     **({"quant_rel_err": metrics.gauges[
                         "serve_quant_rel_err"]}
                        if "serve_quant_rel_err" in metrics.gauges else {}))

@@ -213,12 +213,17 @@ class MetricsRegistry:
             self.sink = None
 
 
-def device_memory_gauges(devices) -> Dict[str, int]:
+def device_memory_gauges(devices) -> Dict[str, float]:
     """HBM gauges from ``device.memory_stats()`` — max over the local
-    devices (the high-water device is the OOM risk).  Empty dict when the
-    backend doesn't report (CPU) — callers omit the fields rather than
-    write zeros that read as "no memory used"."""
-    peak, in_use = None, None
+    devices (the high-water device is the OOM risk; the sentinel keeps
+    watching it), plus the min and the per-device spread when more than
+    one device reports, so a SKEWED shard — one device holding an
+    unsharded embedding while its peers idle — is visible instead of
+    hiding under the max.  Empty dict when the backend doesn't report
+    (CPU) — callers omit the fields rather than write zeros that read
+    as "no memory used"."""
+    peaks: list = []
+    in_uses: list = []
     for d in devices:
         try:
             stats = d.memory_stats()
@@ -227,14 +232,17 @@ def device_memory_gauges(devices) -> Dict[str, int]:
         if not stats:
             continue
         if "peak_bytes_in_use" in stats:
-            v = int(stats["peak_bytes_in_use"])
-            peak = v if peak is None else max(peak, v)
+            peaks.append(int(stats["peak_bytes_in_use"]))
         if "bytes_in_use" in stats:
-            v = int(stats["bytes_in_use"])
-            in_use = v if in_use is None else max(in_use, v)
-    out = {}
-    if peak is not None:
-        out["hbm_peak_bytes"] = peak
-    if in_use is not None:
-        out["hbm_bytes_in_use"] = in_use
+            in_uses.append(int(stats["bytes_in_use"]))
+    out: Dict[str, int] = {}
+    if peaks:
+        out["hbm_peak_bytes"] = max(peaks)
+        if len(peaks) > 1:
+            out["hbm_peak_bytes_min"] = min(peaks)
+            if max(peaks) > 0:
+                out["hbm_peak_spread_pct"] = round(
+                    (max(peaks) - min(peaks)) / max(peaks) * 100.0, 2)
+    if in_uses:
+        out["hbm_bytes_in_use"] = max(in_uses)
     return out

@@ -1755,7 +1755,7 @@ class NetTrainer:
         return [conn_scope_name(i, c)
                 for i, c in enumerate(self.net.connections)]
 
-    def step_hlo_text(self, optimized: bool = True) -> Optional[str]:
+    def step_hlo_text(self) -> Optional[str]:
         """Optimized-HLO text of the compiled train step (AOT-lowered
         from abstract args matching :meth:`update`'s operands), or None
         when this trainer's executed program can't be reproduced that
@@ -1768,14 +1768,33 @@ class NetTrainer:
         compile (the jit execution cache is keyed separately).  Callers
         gate it behind a closed profiling window with an active metrics
         sink, and the text is cached per trainer, so recurring
-        ``prof_every`` windows compile once."""
-        cached = getattr(self, "_step_hlo_cache", None)
+        ``prof_every`` windows compile once.  The same compile also
+        caches :meth:`step_memory_stats` — text and bytes never cost
+        two compiles."""
+        return self._step_aot()[0] or None
+
+    def step_memory_stats(self) -> Optional[Dict[str, int]]:
+        """Measured memory truth of the compiled train step
+        (``compiled.memory_analysis()``): ``args_bytes`` (parameters +
+        batch), ``out_bytes`` (fresh outputs), ``temp_bytes`` (the temp
+        allocation the memory observatory attributes per layer),
+        ``alias_bytes`` (donated buffers the step writes back into),
+        and ``code_bytes`` (generated code).  Per device on SPMD
+        meshes — the numbers describe the partitioned module.  Shares
+        :meth:`step_hlo_text`'s single cached AOT compile; None when
+        that path can't reproduce this trainer's program."""
+        return self._step_aot()[1]
+
+    def _step_aot(self):
+        """(hlo_text, memory_stats) from ONE cached AOT compile of the
+        train step; ("", None) caches a permanent failure."""
+        cached = getattr(self, "_step_aot_cache", None)
         if cached is not None:
-            return cached or None  # "" caches a permanent failure
+            return cached
         if self._s2d_args is not None \
                 or getattr(self, "_overlap_defer", False):
-            self._step_hlo_cache = ""
-            return None
+            self._step_aot_cache = ("", None)
+            return self._step_aot_cache
         try:
             sds = jax.ShapeDtypeStruct
             absify = lambda t: jax.tree.map(  # noqa: E731
@@ -1799,15 +1818,27 @@ class NetTrainer:
             else:
                 lowered = self._train_step.lower(
                     p, o, bu, data, label, extras, epoch, rng)
-            txt = lowered.compile().as_text() if optimized \
-                else lowered.as_text()
+            compiled = lowered.compile()
+            txt = compiled.as_text()
+            stats = None
+            try:
+                ma = compiled.memory_analysis()
+                stats = {
+                    "args_bytes": int(ma.argument_size_in_bytes),
+                    "out_bytes": int(ma.output_size_in_bytes),
+                    "temp_bytes": int(ma.temp_size_in_bytes),
+                    "alias_bytes": int(ma.alias_size_in_bytes),
+                    "code_bytes": int(ma.generated_code_size_in_bytes),
+                }
+            except Exception:  # noqa: BLE001 — optional backend API
+                pass
         except Exception as e:  # noqa: BLE001 — telemetry only
             mlog.warn(f"step_hlo_text: lowering failed ({e}); layer "
                       "attribution will report unattributed time only")
-            self._step_hlo_cache = ""
-            return None
-        self._step_hlo_cache = txt
-        return txt
+            self._step_aot_cache = ("", None)
+            return self._step_aot_cache
+        self._step_aot_cache = (txt, stats)
+        return self._step_aot_cache
 
     def accumulate_train_metric(self, outs, label, n_padd: int = 0) -> None:
         """Add one batch's eval-node outputs to the train metric (shared by

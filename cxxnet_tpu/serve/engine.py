@@ -223,6 +223,58 @@ class PredictEngine:
         return self.metrics.counters.get("serve_step_traces", 0) \
             - self._traces_at_warmup
 
+    def footprint(self) -> Dict[str, int]:
+        """Per-device resident bytes this model costs the host
+        (doc/memory.md): everything serving keeps alive — the
+        serve-variant weight tree counted ONCE (every bucket executable
+        shares it), the trainer's buffers (batch-norm stats ride into
+        every dispatch), and, for a cast/quantized variant, the
+        trainer's ORIGINAL params too (the trainer stays alive, so both
+        copies are resident; an f32 variant aliases them, one copy) —
+        plus the live trainer's optimizer state (``opt_bytes``:
+        momentum is 1x param bytes, adam 2x, f32 masters more — the
+        trainer materializes it at load and serving keeps it resident)
+        and each warmed bucket's temp/output/code allocations from
+        ``memory_analysis()``.  The number the multi-model host packs
+        against instead of packing blind.  Empty dict before warmup or
+        when the backend doesn't report."""
+        if not self._fns:
+            return {}
+        # the ONE shard-aware per-device accounting rule, shared with
+        # the analytic memory model
+        from ..analysis.memmodel import (leaf_device_bytes,
+                                         tree_device_bytes)
+        weight = tree_device_bytes(self._params) \
+            + tree_device_bytes(self._scales) \
+            + tree_device_bytes(self.trainer.buffers)
+        if self.dtype == "bf16":
+            # the whole cast tree is a copy; the trainer's f32 tree
+            # stays resident alongside it
+            weight += tree_device_bytes(self.trainer.params)
+        elif self.dtype == "int8":
+            # only the quantized wmat leaves are copies — the rest of
+            # the serve tree aliases the trainer's groups
+            for pkey in self._quant_keys():
+                g = self.trainer.params.get(pkey, {})
+                if "wmat" in g:
+                    weight += leaf_device_bytes(g["wmat"])
+        opt = tree_device_bytes(getattr(self.trainer, "opt_state", {})
+                                or {})
+        temp = out = code = 0
+        for fn in self._fns.values():
+            try:
+                ma = fn.memory_analysis()
+            except Exception:  # noqa: BLE001 — optional backend API
+                return {}
+            temp += int(ma.temp_size_in_bytes)
+            out += int(ma.output_size_in_bytes)
+            code += int(ma.generated_code_size_in_bytes)
+        return {"weight_bytes": weight, "opt_bytes": opt,
+                "exec_temp_bytes": temp,
+                "exec_out_bytes": out, "exec_code_bytes": code,
+                "buckets": len(self._fns),
+                "total_bytes": weight + opt + temp + out + code}
+
     # ------------------------------------------------------------ predict
     def bucket_for(self, n: int) -> int:
         """Smallest declared bucket holding ``n`` rows."""

@@ -71,6 +71,12 @@ class ServeModel:
     def retraces(self) -> int:
         return self.engine.retraces
 
+    def footprint(self) -> Dict[str, int]:
+        """Per-device resident bytes this model costs the host
+        (engine weights + warmed executables — doc/memory.md); empty
+        before warmup."""
+        return self.engine.footprint()
+
     def close(self) -> None:
         self.batcher.close()
 
@@ -118,6 +124,16 @@ class ModelHost:
 
     def retraces(self) -> int:
         return sum(m.retraces for m in self._models.values())
+
+    def footprint(self) -> Dict[str, object]:
+        """Per-model + combined resident bytes over the shared device
+        pool — the number to pack against before adding one model too
+        many (doc/memory.md; the pool's HBM capacity is
+        analysis/costmodel.HBM_BYTES)."""
+        per = {name: m.footprint() for name, m in self._models.items()}
+        return {"models": per,
+                "total_bytes": sum(fp.get("total_bytes", 0)
+                                   for fp in per.values())}
 
     def close(self) -> None:
         for m in self._models.values():

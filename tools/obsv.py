@@ -119,13 +119,30 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
             "rows": (lp.get("rows") or [])[:top],
             "dropped_rows": max(len(lp.get("rows") or []) - top, 0),
         }
+    if by.get("mem_profile"):
+        mp = by["mem_profile"][-1]
+        rep["memory"] = {
+            "round": mp.get("round"),
+            "peak_live_bytes": mp.get("peak_live_bytes"),
+            "peak_frac": mp.get("peak_frac"),
+            "coverage": mp.get("coverage"),
+            "exec": mp.get("exec"),
+            "model": mp.get("model"),
+            "hbm_capacity_bytes": mp.get("hbm_capacity_bytes"),
+            "hbm_peak_bytes": mp.get("hbm_peak_bytes"),
+            "hbm_peak_spread_pct": mp.get("hbm_peak_spread_pct"),
+            "timeline": mp.get("timeline") or [],
+            "rows": (mp.get("rows") or [])[:top],
+            "dropped_rows": max(len(mp.get("rows") or []) - top, 0),
+        }
     if by.get("serve"):
         rep["serving"] = [
             {k: r.get(k) for k in
              ("model", "requests", "duration_sec", "qps", "offered_qps",
               "batches", "mean_batch", "batch_hist", "queue_depth_mean",
               "queue_depth_max", "dtype", "shapes", "clients", "retraces",
-              "quant_rel_err") if k in r} for r in by["serve"]]
+              "quant_rel_err", "footprint") if k in r}
+            for r in by["serve"]]
     if by.get("span"):
         # request-path p99 decomposition (doc/monitor.md "Reading a
         # p99 breakdown"): per-stage latency percentiles + share of
@@ -193,6 +210,13 @@ def _fmt(v, nd=3) -> str:
     if isinstance(v, float):
         return f"{v:.{nd}f}".rstrip("0").rstrip(".")
     return str(v)
+
+
+def _mb(v) -> str:
+    """Bytes -> a compact MB string (memory tables stay readable)."""
+    if v is None:
+        return "-"
+    return f"{v / 1e6:.2f}M"
 
 
 def _table(headers: List[str], rows: List[List[str]]) -> str:
@@ -266,6 +290,52 @@ def render(rep: dict) -> str:
         if lp.get("dropped_rows"):
             out.append(f"... {lp['dropped_rows']} more rows "
                        "(--top to widen)")
+    mem = rep.get("memory")
+    if mem:
+        out.append("")
+        cap = mem.get("hbm_capacity_bytes")
+        line = (f"memory (round {mem.get('round')}): peak live "
+                f"{_mb(mem.get('peak_live_bytes'))} temps at "
+                f"{_fmt(mem.get('peak_frac'))} of the step "
+                f"(coverage {_fmt(mem.get('coverage'))})")
+        ex = mem.get("exec") or {}
+        if ex:
+            line += (f"; exec args {_mb(ex.get('args_bytes'))} + out "
+                     f"{_mb(ex.get('out_bytes'))} + temps "
+                     f"{_mb(ex.get('temp_bytes'))}")
+        out.append(line)
+        hbm = mem.get("hbm_peak_bytes")
+        if hbm or cap:
+            l2 = "hbm: "
+            if hbm:
+                l2 += f"measured peak {_mb(hbm)}"
+                if mem.get("hbm_peak_spread_pct"):
+                    l2 += (" (device spread "
+                           f"{_fmt(mem['hbm_peak_spread_pct'], 1)}%)")
+            if cap:
+                l2 += ("" if not hbm else ", ") + f"capacity {_mb(cap)}"
+                mdl = (mem.get("model") or {}).get("est_peak_bytes")
+                if mdl:
+                    l2 += (f", modeled peak {_mb(mdl)} "
+                           f"({mdl / cap:.0%} full)")
+            out.append(l2)
+        tl = mem.get("timeline") or []
+        if tl and max(tl) > 0:
+            blocks = " ▁▂▃▄▅▆▇█"
+            out.append("live temps over the step: " + "".join(
+                blocks[min(int(v / max(tl) * 8), 8)] for v in tl))
+        rows = [[r.get("layer", "?"), _mb(r.get("param_bytes")),
+                 _mb(r.get("opt_bytes")), _mb(r.get("act_bytes")),
+                 _mb(r.get("total_bytes")), _fmt(r.get("share")),
+                 _fmt(r.get("model_x"), 2)]
+                for r in mem.get("rows") or []]
+        if rows:
+            out.append(_table(
+                ["layer", "param", "opt", "act@peak", "total",
+                 "share", "x_model"], rows))
+        if mem.get("dropped_rows"):
+            out.append(f"... {mem['dropped_rows']} more rows "
+                       "(--top to widen)")
     srv = rep.get("serving")
     if srv:
         out.append("")
@@ -276,12 +346,14 @@ def render(rep: dict) -> str:
                "the declared buckets"))
         out.append(_table(
             ["model", "dtype", "qps", "requests", "batches", "mean_b",
-             "q_mean", "q_max"],
+             "q_mean", "q_max", "footprint"],
             [[str(r.get("model", "?")), str(r.get("dtype", "?")),
               _fmt(r.get("qps"), 1), _fmt(r.get("requests")),
               _fmt(r.get("batches")), _fmt(r.get("mean_batch")),
               _fmt(r.get("queue_depth_mean")),
-              _fmt(r.get("queue_depth_max"))] for r in srv]))
+              _fmt(r.get("queue_depth_max")),
+              _mb((r.get("footprint") or {}).get("total_bytes"))]
+             for r in srv]))
         hist = srv[-1].get("batch_hist") or {}
         if hist:
             total = sum(hist.values()) or 1
