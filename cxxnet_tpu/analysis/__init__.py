@@ -14,18 +14,33 @@ from typing import List, Tuple
 from .schema import Finding, K, KeySpec  # noqa: F401 (re-export)
 
 
-def run_check(cfg, path: str = "", trace: bool = True
-              ) -> Tuple[List[Finding], int]:
+def run_check(cfg, path: str = "", trace: bool = True,
+              spmd: bool = None) -> Tuple[List[Finding], int]:
     """Lint an ordered config-pair list; returns (findings, exit_code).
 
     Static config lint always runs; the traced-graph lint additionally
     builds the configured net on CPU and walks the step jaxpr when the
     config carries a ``netconfig`` block (pred-from-checkpoint configs
-    don't) and ``trace`` is on.  Exit code 1 iff any error-severity
-    finding."""
+    don't) and ``trace`` is on.  The SPMD deep lint
+    (analysis/spmdlint.py: collective-consistency, donation audit,
+    dtype-flow) rides the same traced pass; ``spmd = None`` follows the
+    config's ``spmd_check`` key (default on).  Exit code 1 iff any
+    error-severity finding."""
     from . import conflint
     findings = conflint.lint_pairs(cfg, path=path)
     has_net = any(k.startswith("layer[") for k, _ in cfg)
+    # warn about --no-trace starving the SPMD lint only when it was
+    # EXPLICITLY requested (--spmd or spmd_check = 1 in the config) —
+    # the default-on case would turn every fast config-lint-only sweep
+    # into one noise line per config (the mem_check guard's rule)
+    spmd_explicit = spmd is True or dict(cfg).get("spmd_check") == "1"
+    if spmd is None:
+        spmd = dict(cfg).get("spmd_check", "1") == "1"
+    if spmd_explicit and not trace and has_net:
+        findings.append(Finding(
+            "warn", "spmd_check",
+            "the SPMD deep lint needs the traced-graph pass; --no-trace "
+            "disables it", scope="spmd"))
     if dict(cfg).get("mem_check", "0") == "1" \
             and (not trace or not has_net):
         findings.append(Finding(
@@ -42,7 +57,7 @@ def run_check(cfg, path: str = "", trace: bool = True
             "info", "", "no netconfig block in this config; "
             "traced-graph lint skipped", scope="jaxpr"))
     else:
-        findings.extend(_trace_findings(cfg))
+        findings.extend(_trace_findings(cfg, spmd=spmd))
     n_err = sum(1 for f in findings if f.severity == "error")
     return findings, (1 if n_err else 0)
 
@@ -58,7 +73,7 @@ def _ensure_host_devices(n: int) -> None:
     ensure_host_platform_devices(max(n, 8))
 
 
-def _trace_findings(cfg) -> List[Finding]:
+def _trace_findings(cfg, spmd: bool = True) -> List[Finding]:
     """Build the configured trainer on CPU and lint its traced step.
     Build failures become findings instead of crashes: a config whose net
     cannot even be constructed (bad shapes, undefined nodes) is exactly
@@ -138,11 +153,14 @@ def _trace_findings(cfg) -> List[Finding]:
         except Exception as e:  # noqa: BLE001 — environment, not config
             return [F("warn", "", "traced-graph lint skipped: could not "
                       f"build the train step on cpu ({e})", scope="jaxpr")]
-        finally:
-            mlog.set_silent(1 if was_silent else 0)
         out: List[Finding] = []
+        closed = None
         try:
-            out.extend(jaxpr_lint.lint_trainer(net))
+            # trace ONCE: the jaxpr lint and the SPMD deep lint walk the
+            # same closed jaxpr (a second abstract trace of a flagship
+            # net is seconds of pure waste per config)
+            closed = jaxpr_lint.trace_step(net)
+            out.extend(jaxpr_lint.lint_trainer(net, closed=closed))
         except Exception as e:  # noqa: BLE001 — lint must not crash check
             out.append(F("warn", "", f"traced-graph lint failed: {e}",
                         scope="jaxpr"))
@@ -156,7 +174,23 @@ def _trace_findings(cfg) -> List[Finding]:
         except Exception as e:  # noqa: BLE001 — lint must not crash check
             out.append(F("warn", "mem_check",
                          f"memory pre-flight failed: {e}", scope="mem"))
+        # SPMD deep lint (spmdlint.py): collective-consistency over the
+        # same traced jaxpr, donation audit off the step's alias map,
+        # dtype-flow vs the declared precision contracts.  Runs inside
+        # the engine-snapshot window so dp_reduce_dtype reflects THIS
+        # config, not the previous one in a multi-config graftlint run
+        if spmd and closed is not None:
+            try:
+                from . import spmdlint
+                out.extend(spmdlint.lint_trainer(net, closed, cfg))
+            except Exception as e:  # noqa: BLE001 — must not crash check
+                out.append(F("warn", "spmd_check",
+                             f"SPMD lint failed: {e}", scope="spmd"))
         return out
     finally:
+        # silence stays on through the lint passes too: the SPMD
+        # donation audit lowers the step, which re-triggers build-time
+        # chatter (bucket plans) that is lint noise here
+        mlog.set_silent(1 if was_silent else 0)
         for k, v in engine_snap.items():
             setattr(engine.opts, k, v)

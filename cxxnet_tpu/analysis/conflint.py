@@ -275,6 +275,12 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                         "dp_reduce_at = apply has no effect without "
                         "update_period > 1 (there is only one reduce per "
                         "apply either way)"))
+    elif "dp_reduce_dtype" in last:
+        add(Finding("warn", "dp_reduce_dtype",
+                    "dp_reduce_dtype only changes the wire dtype of the "
+                    "explicit dp_overlap = 1 bucketed reduction; without "
+                    "dp_overlap the key is silently ignored (the "
+                    "implicit GSPMD psum reduces in the gradient dtype)"))
     _mesh_rules(last, layer_types, update_period, batch_size, add)
     if monitor and multi_step > 1:
         add(Finding("warn", "multi_step",
@@ -695,10 +701,36 @@ def _mesh_rules(last: Dict[str, str], layer_types: List[str],
                     "the model axis shards nothing here (fullc_gather = 0 "
                     "and no moe layer): model-axis devices replicate "
                     "work; set fullc_gather = 1 to shard fullc weights"))
+    # pipeline-axis rules (ahead of the 1F1B graduation, ROADMAP item 5):
+    # a pipe axis needs a net deep enough to cut into that many stages —
+    # layer count is the static proxy for stage-able boundaries
+    npipe = axes.get("pipe", 1)
+    if npipe > 1:
+        if not layer_types:
+            add(Finding("warn", "mesh",
+                        f"mesh = {mesh_str} carries a pipe axis of "
+                        f"{npipe} stages but the config has no netconfig "
+                        "block: there is nothing to cut into stages"))
+        elif len(layer_types) < npipe:
+            add(Finding("warn", "mesh",
+                        f"mesh = {mesh_str} asks for {npipe} pipeline "
+                        f"stages but the net declares only "
+                        f"{len(layer_types)} layer(s); stages would sit "
+                        "empty — shrink the pipe axis or deepen the net"))
     if last.get("dp_overlap") != "1":
         return
     extra_ax = [a for a, s in axes.items()
                 if a not in ("data", "model") and s > 1]
+    if "pipe" in extra_ax:
+        # the trainer's trace-time warn-once fallback, repeated at check
+        # time (the reason it is info here: the run still works, on the
+        # implicit-psum step)
+        add(Finding("info", "dp_overlap",
+                    "dp_overlap = 1 with a pipe axis: the pipeline "
+                    "schedule owns the backward walk, so the trainer "
+                    "takes the documented warn-once fallback to the "
+                    "implicit-psum step at trace time (doc/multichip.md)"))
+        extra_ax = [a for a in extra_ax if a != "pipe"]
     if extra_ax:
         add(Finding("warn", "dp_overlap",
                     f"dp_overlap = 1 with mesh axes {'/'.join(extra_ax)}: "

@@ -45,9 +45,13 @@ CONST_BYTES_LIMIT = 1 << 20
 
 # ------------------------------------------------------------ jaxpr walk
 def _jaxprs_in(v) -> Iterable:
-    """ClosedJaxpr values nested inside an eqn params value."""
+    """ClosedJaxpr values nested inside an eqn params value.  shard_map
+    carries a PLAIN Jaxpr in its ``jaxpr`` param (no consts) — wrap it so
+    the walk reaches collective/compute eqns inside the SPMD body too."""
     if isinstance(v, ClosedJaxpr):
         yield v
+    elif isinstance(v, Jaxpr):
+        yield ClosedJaxpr(v, ())
     elif isinstance(v, (tuple, list)):
         for x in v:
             yield from _jaxprs_in(x)
@@ -172,13 +176,16 @@ def _dp_findings(trainer) -> List[Finding]:
     return dp_coverage_findings(list(trainer.params), covered)
 
 
-def lint_trainer(trainer) -> List[Finding]:
-    """Abstract-trace the configured train step and lint the jaxpr.
+def trace_step(trainer) -> "ClosedJaxpr":
+    """Abstract-trace the configured train step to a closed jaxpr.
 
     The step body is traced directly (the same ``_loss_and_grads`` +
     ``_apply_update`` composition the jitted step wraps) so that
     closure-captured values surface as jaxpr constants while
-    params/opt_state/buffers — passed as arguments — stay invars."""
+    params/opt_state/buffers — passed as arguments — stay invars.
+    Shared by :func:`lint_trainer` and the SPMD deep lint
+    (analysis/spmdlint.py): ``task=check`` traces once and every pass
+    walks the same program."""
     eval_ids = tuple(dict.fromkeys(trainer.eval_node_ids))
     net = trainer.net
     data_shape = net.node_shapes[0]
@@ -207,9 +214,16 @@ def lint_trainer(trainer) -> List[Finding]:
                                              epoch)
         return loss, new_p, new_s, new_buffers, outs
 
-    closed = jax.make_jaxpr(step)(
+    return jax.make_jaxpr(step)(
         trainer.params, trainer.opt_state, trainer.buffers, data, label,
         extras, rng, epoch)
+
+
+def lint_trainer(trainer, closed: "ClosedJaxpr" = None) -> List[Finding]:
+    """Lint the trainer's traced step jaxpr (pass ``closed`` to reuse a
+    :func:`trace_step` result instead of tracing again)."""
+    if closed is None:
+        closed = trace_step(trainer)
     findings = jaxpr_findings(closed)
     findings.extend(weak_leaf_findings({
         "params": trainer.params, "opt_state": trainer.opt_state,

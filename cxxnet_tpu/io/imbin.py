@@ -34,6 +34,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..analysis.schema import K
+from ..monitor import log as mlog
 from .data import DataInst, IIterator
 
 MAGIC = b"CXTPUBIN"
@@ -43,7 +44,9 @@ DEFAULT_PAGE_SIZE = 64 << 20  # 64MB, reference page size
 
 class BinaryPageWriter:
     def __init__(self, path: str, page_size: int = DEFAULT_PAGE_SIZE):
-        self.f = open(path, "wb")
+        # incremental page stream (push() per image, O(page) memory);
+        # data-prep reruns on a torn shard, so no atomic_write staging
+        self.f = open(path, "wb")  # disclint: ok(atomic-write)
         self.page_size = page_size
         self.f.write(MAGIC + struct.pack("<IQ", VERSION, page_size))
         self._recs: List[bytes] = []
@@ -210,8 +213,8 @@ class ImageBinIterator(IIterator):
                         np.array([float(t) for t in
                                   toks[1:1 + self.label_width]], np.float32))
         if not self.silent:
-            print(f"ImageBinIterator: {len(self.labels)} images in "
-                  f"{len(self.bins)} shard(s)")
+            mlog.info(f"ImageBinIterator: {len(self.labels)} images in "
+                      f"{len(self.bins)} shard(s)")
 
     def _page_offsets(self):
         """Global instance offset of each shard's first record (labels were
@@ -386,7 +389,7 @@ class ImageIterator(IIterator):
         self.order = np.arange(len(self.items))
         self._epochs = 0
         if not self.silent:
-            print(f"ImageIterator: {len(self.items)} images")
+            mlog.info(f"ImageIterator: {len(self.items)} images")
 
     def before_first(self):
         if self.shuffle:

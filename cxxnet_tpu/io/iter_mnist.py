@@ -16,6 +16,7 @@ import struct
 import numpy as np
 
 from ..analysis.schema import K
+from ..monitor import log as mlog
 from .data import DataBatch, IIterator
 
 _RAND_MAGIC = 27  # distinct fixed seed per subsystem, reference style
@@ -89,8 +90,8 @@ class MNISTIterator(IIterator):
             shape = (self.batch_size, 1, 1, self.img.shape[1] * self.img.shape[2]) \
                 if self.input_flat else \
                 (self.batch_size, 1, self.img.shape[1], self.img.shape[2])
-            print(f"MNISTIterator: load {len(self.img)} images, "
-                  f"shuffle={self.shuffle}, shape={shape}")
+            mlog.info(f"MNISTIterator: load {len(self.img)} images, "
+                      f"shuffle={self.shuffle}, shape={shape}")
 
     def before_first(self):
         self.loc = 0

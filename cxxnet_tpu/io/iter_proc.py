@@ -19,6 +19,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..analysis.schema import K
+from ..monitor import log as mlog
 from .data import DataBatch, DataInst, IIterator
 from .device_prefetch import ProducerError, generation_put
 
@@ -327,7 +328,7 @@ class AugmentIterator(IIterator):
         assert n > 0, "augment: empty dataset, cannot build mean image"
         self._mean = (acc / n).astype(np.float32)
         np.savez(self.mean_file, mean=self._mean)
-        print(f"AugmentIterator: saved mean image to {self.mean_file}")
+        mlog.info(f"AugmentIterator: saved mean image to {self.mean_file}")
 
     def before_first(self):
         self.base.before_first()
@@ -369,9 +370,10 @@ class AugmentIterator(IIterator):
                 else:  # affine resized past the mean image: channel means
                     if not self._warned_mean_fallback:
                         self._warned_mean_fallback = True
-                        print(f"AugmentIterator: mean image {m.shape} smaller "
-                              f"than instance {d.shape}; falling back to "
-                              "per-channel scalar means", file=sys.stderr)
+                        mlog.warn(
+                            f"AugmentIterator: mean image {m.shape} "
+                            f"smaller than instance {d.shape}; falling "
+                            "back to per-channel scalar means")
                     m = m.mean(axis=(1, 2), keepdims=True)
             d = d - m
         elif self.mean_value is not None:

@@ -205,8 +205,8 @@ class TextIterator(IIterator):
         self._ndocs = int(self._doc_base[-1])
         if not self.silent:
             ntok = sum(s.ntokens for s in self.shards)
-            print(f"TextIterator: {self._ndocs} docs / {ntok} tokens in "
-                  f"{len(self.shards)} shard(s)")
+            mlog.info(f"TextIterator: {self._ndocs} docs / {ntok} "
+                      f"tokens in {len(self.shards)} shard(s)")
 
     def before_first(self):
         self._gen += 1

@@ -137,6 +137,19 @@ def output_aliases(hlo_text: str) -> Dict[int, int]:
     return {int(o): int(p) for o, p in _ALIAS.findall(body)}
 
 
+def entry_param_count(hlo_text: str) -> int:
+    """Number of ENTRY-computation parameters — the SPMD donation audit
+    (analysis/spmdlint.py) sanity-checks this against the flattened
+    operand trees before attributing alias-map param numbers to leaves
+    (nested computations carry their own parameters, so a global regex
+    would overcount)."""
+    n = 0
+    for line in _entry_lines(hlo_text):
+        if " parameter(" in line:
+            n += 1
+    return n
+
+
 def hlo_entry_buffers(hlo_text: str, scopes: Sequence[str]
                       ) -> List[BufferInfo]:
     """Parse the ENTRY computation into buffer rows (program order).

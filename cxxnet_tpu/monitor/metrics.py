@@ -96,7 +96,9 @@ class Histogram:
 class JsonlSink:
     def __init__(self, path: str):
         self.path = path
-        self._fo: TextIO = open(path, "a")
+        # append-only stream by design (torn tails are tolerated by
+        # every JSONL reader here; atomic_write would buffer the run)
+        self._fo: TextIO = open(path, "a")  # disclint: ok(atomic-write)
         # the async checkpoint writer emits its `ckpt` record from the
         # writer thread while the train loop emits step records; a
         # buffered TextIOWrapper is not thread-safe, so serialize writes

@@ -104,6 +104,45 @@ TRAINER_KEYS = (
 )
 
 
+def _lowered_arg_aliases(mlir_text: str):
+    """(donated arg indices, total arg count) from a lowered StableHLO
+    module's ``@main`` signature.  jax establishes input/output aliases
+    at lowering time (a donated arg whose aval matches an output gets a
+    ``tf.aliasing_output`` attribute; an unusable donation gets none),
+    so this reads the SAME decision the compiled module's
+    ``input_output_alias`` header records — without paying the XLA
+    compile."""
+    start = mlir_text.find("@main(")
+    if start < 0:
+        return set(), -1
+    i = start + len("@main(")
+    depth = 1
+    in_str = False
+    args: List[str] = []
+    buf: List[str] = []
+    while i < len(mlir_text) and depth > 0:
+        ch = mlir_text[i]
+        if ch == '"':
+            in_str = not in_str
+        elif not in_str:
+            if ch in "({[":
+                depth += 1
+            elif ch in ")}]":
+                depth -= 1
+                if depth == 0:
+                    break
+        if ch == "," and depth == 1 and not in_str:
+            args.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    if "".join(buf).strip():
+        args.append("".join(buf))
+    donated = {k for k, a in enumerate(args) if "tf.aliasing_output" in a}
+    return donated, len(args)
+
+
 class NetTrainer:
     """Config-driven trainer (INetTrainer parity: SetParam/InitModel/
     SaveModel/LoadModel/StartRound/Update/Evaluate/Predict/ExtractFeature/
@@ -1785,39 +1824,74 @@ class NetTrainer:
         that path can't reproduce this trainer's program."""
         return self._step_aot()[1]
 
+    def _step_abstract_args(self):
+        """Abstract operand tuple matching the jitted train step's
+        signature, or None when the executed program can't be reproduced
+        by AOT lowering (input_s2d staging shapes, the
+        dp_reduce_at=apply two-step path)."""
+        if self._s2d_args is not None \
+                or getattr(self, "_overlap_defer", False):
+            return None
+        sds = jax.ShapeDtypeStruct
+        absify = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: sds(x.shape, x.dtype), t)
+        shp = self.net.node_shapes[0]
+        label_w = max([b for _, _, b in self._label_fields], default=1)
+        data = sds((self.batch_size,) + tuple(shp[1:]), np.float32)
+        label = sds((self.batch_size, label_w), np.float32)
+        extras = tuple(
+            sds((self.batch_size,)
+                + tuple(self.net.node_shapes[1 + i][1:]), np.float32)
+            for i in range(self.netcfg.extra_data_num))
+        p, o, bu = (absify(self.params), absify(self.opt_state),
+                    absify(self.buffers))
+        epoch = sds((), np.int32)
+        rng = jax.random.PRNGKey(0)
+        if self.update_period > 1:
+            return (p, o, bu, absify(self.params), data, label, extras,
+                    epoch, rng, sds((), np.bool_))
+        return (p, o, bu, data, label, extras, epoch, rng)
+
+    def _step_lowered(self):
+        """Cached ``.lower()`` of the train step — tracing + StableHLO
+        emission only, NO XLA compile (the donation audit reads aliasing
+        attributes off this; :meth:`_step_aot` compiles it further).
+        None when the executed program can't be reproduced or lowering
+        fails (failure is cached)."""
+        cached = getattr(self, "_step_lowered_cache", None)
+        if cached is not None:
+            return cached or None
+        args = self._step_abstract_args()
+        if args is None:
+            self._step_lowered_cache = False
+            return None
+        try:
+            import warnings as _warnings
+            with _warnings.catch_warnings():
+                # an unusable donation is the AUDIT's finding
+                # (spmd_undonated), not loose stderr chatter
+                _warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                lowered = self._train_step.lower(*args)
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            mlog.warn(f"step lowering failed ({e}); layer attribution "
+                      "and the donation audit are unavailable")
+            self._step_lowered_cache = False
+            return None
+        self._step_lowered_cache = lowered
+        return lowered
+
     def _step_aot(self):
         """(hlo_text, memory_stats) from ONE cached AOT compile of the
         train step; ("", None) caches a permanent failure."""
         cached = getattr(self, "_step_aot_cache", None)
         if cached is not None:
             return cached
-        if self._s2d_args is not None \
-                or getattr(self, "_overlap_defer", False):
+        lowered = self._step_lowered()
+        if lowered is None:
             self._step_aot_cache = ("", None)
             return self._step_aot_cache
         try:
-            sds = jax.ShapeDtypeStruct
-            absify = lambda t: jax.tree.map(  # noqa: E731
-                lambda x: sds(x.shape, x.dtype), t)
-            shp = self.net.node_shapes[0]
-            label_w = max([b for _, _, b in self._label_fields], default=1)
-            data = sds((self.batch_size,) + tuple(shp[1:]), np.float32)
-            label = sds((self.batch_size, label_w), np.float32)
-            extras = tuple(
-                sds((self.batch_size,)
-                    + tuple(self.net.node_shapes[1 + i][1:]), np.float32)
-                for i in range(self.netcfg.extra_data_num))
-            p, o, bu = (absify(self.params), absify(self.opt_state),
-                        absify(self.buffers))
-            epoch = sds((), np.int32)
-            rng = jax.random.PRNGKey(0)
-            if self.update_period > 1:
-                lowered = self._train_step.lower(
-                    p, o, bu, absify(self.params), data, label, extras,
-                    epoch, rng, sds((), np.bool_))
-            else:
-                lowered = self._train_step.lower(
-                    p, o, bu, data, label, extras, epoch, rng)
             compiled = lowered.compile()
             txt = compiled.as_text()
             stats = None
@@ -1830,15 +1904,65 @@ class NetTrainer:
                     "alias_bytes": int(ma.alias_size_in_bytes),
                     "code_bytes": int(ma.generated_code_size_in_bytes),
                 }
+            # disclint: ok(swallow) — stats stay None, callers gate
             except Exception:  # noqa: BLE001 — optional backend API
                 pass
         except Exception as e:  # noqa: BLE001 — telemetry only
-            mlog.warn(f"step_hlo_text: lowering failed ({e}); layer "
+            mlog.warn(f"step_hlo_text: compile failed ({e}); layer "
                       "attribution will report unattributed time only")
             self._step_aot_cache = ("", None)
             return self._step_aot_cache
         self._step_aot_cache = (txt, stats)
         return self._step_aot_cache
+
+    def step_donation_report(self) -> Optional[Dict[str, Any]]:
+        """Per-leaf donation truth of the train step — the alias map the
+        SPMD lint's donation audit (analysis/spmdlint.py) checks.
+
+        Rows cover the donated operand trees in jitted-argument order
+        (params, opt_state, buffers, and the param-shaped grad
+        accumulator under ``update_period > 1``): each row carries the
+        leaf's tree, key path, bytes, and whether the step aliases an
+        output onto it.  Source selection: when the AOT compile is
+        already cached (:meth:`step_hlo_text` / :meth:`step_memory_stats`
+        paid for it) the optimized module's ``input_output_alias``
+        header is authoritative; otherwise the aliasing attributes of
+        the un-optimized lowered module are parsed — same decision
+        point (jax establishes aliases at lowering), no XLA compile.
+        None when the executed program can't be reproduced by AOT
+        lowering or the parsed argument count doesn't match the
+        flattened operand trees (nothing to attribute against)."""
+        trees = [("params", self.params), ("opt_state", self.opt_state),
+                 ("buffers", self.buffers)]
+        if self.update_period > 1:
+            trees.append(("grad_acc", self.params))
+        leaves: List[Dict[str, Any]] = []
+        for tname, tree in trees:
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                n = 1
+                for d in getattr(leaf, "shape", ()):
+                    n *= int(d)
+                leaves.append({
+                    "tree": tname, "path": jax.tree_util.keystr(path),
+                    "bytes": n * jnp.dtype(leaf.dtype).itemsize})
+        txt = (getattr(self, "_step_aot_cache", None) or ("", None))[0]
+        if txt:
+            from ..monitor.memory import entry_param_count, output_aliases
+            donated = set(output_aliases(txt).values())
+            n_args, source = entry_param_count(txt), "hlo"
+        else:
+            lowered = self._step_lowered()
+            if lowered is None:
+                return None
+            donated, n_args = _lowered_arg_aliases(lowered.as_text())
+            source = "lowered"
+        if n_args < len(leaves):
+            return None  # arg order can't be attributed to the trees
+        for i, row in enumerate(leaves):
+            row["donated"] = i in donated
+        return {"source": source, "n_args": n_args, "leaves": leaves,
+                "alias_bytes": sum(r["bytes"] for r in leaves
+                                   if r["donated"])}
 
     def accumulate_train_metric(self, outs, label, n_padd: int = 0) -> None:
         """Add one batch's eval-node outputs to the train metric (shared by

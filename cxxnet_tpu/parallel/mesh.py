@@ -144,6 +144,15 @@ class MeshSpec:
         return self.axes.get(name, 1)
 
 
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    """Axis name -> size for a BUILT mesh — the axis metadata the SPMD
+    deep lint (analysis/spmdlint.py) checks traced collective axis names
+    against.  One accessor so the checker and the runtime can never
+    disagree about which axes exist or how wide they are (a collective
+    on an axis missing here is the multi-host deadlock class)."""
+    return {str(name): int(size) for name, size in mesh.shape.items()}
+
+
 def build_mesh(devices: Sequence[jax.Device],
                spec: Optional[MeshSpec] = None) -> Mesh:
     """Build a Mesh; default one-axis "data" mesh over all given devices."""

@@ -274,6 +274,54 @@ def test_rule_dp_overlap_mesh_combos():
     assert not by_key(quiet, "dp_overlap")
 
 
+def test_rule_pipe_axis_needs_multi_stage_net():
+    """A pipe axis with a net too shallow to cut into that many stages
+    warns; a config with no netconfig block warns too (ISSUE 14
+    satellite, ahead of the 1F1B graduation)."""
+    shallow = conflint.lint_pairs(parse_config_string(
+        "mesh = pipe:4\ndev = cpu:0-3\n"
+        "netconfig=start\nlayer[+1] = fullc\n  nhidden = 4\n"
+        "netconfig=end\ninput_shape = 1,1,8\nbatch_size = 4\n"))
+    assert any("pipeline stages" in f.message
+               for f in by_key(shallow, "mesh"))
+    nonet = conflint.lint_pairs(parse_config_string(
+        "mesh = pipe:2\ndev = cpu:0-1\n"))
+    assert any("nothing to cut into stages" in f.message
+               for f in by_key(nonet, "mesh"))
+    deep = conflint.lint_pairs(parse_config_string(
+        "mesh = pipe:2\ndev = cpu:0-1\n"
+        "netconfig=start\n"
+        "layer[+1] = fullc\n  nhidden = 8\nlayer[+1] = relu\n"
+        "layer[+1] = fullc\n  nhidden = 4\nlayer[+0] = softmax\n"
+        "netconfig=end\ninput_shape = 1,1,8\nbatch_size = 4\n"))
+    assert not any("stages" in f.message for f in by_key(deep, "mesh"))
+
+
+def test_rule_pipe_with_dp_overlap_is_info():
+    """pipe x dp_overlap repeats the trainer's documented warn-once
+    fallback as a lint info (the run still works, implicitly)."""
+    findings = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,pipe:2\ndev = cpu:0-3\n"))
+    hits = [f for f in by_key(findings, "dp_overlap")
+            if "pipeline schedule" in f.message]
+    assert hits and hits[0].severity == "info"
+    # a seq axis still gets the generic fallback WARN, not the info
+    seq = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,seq:2\ndev = cpu:0-3\n"))
+    assert any(f.severity == "warn" and "fall back" in f.message
+               for f in by_key(seq, "dp_overlap"))
+
+
+def test_rule_dp_reduce_dtype_without_overlap_warns():
+    findings = conflint.lint_pairs(
+        parse_config_string("dp_reduce_dtype = bf16\n"))
+    assert any("silently ignored" in f.message
+               for f in by_key(findings, "dp_reduce_dtype"))
+    quiet = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\ndp_reduce_dtype = bf16\n"))
+    assert not by_key(quiet, "dp_reduce_dtype")
+
+
 def test_rule_monitor_nan_without_monitor():
     findings = conflint.lint_pairs(
         parse_config_string("monitor_nan = fatal\n"))

@@ -1,0 +1,172 @@
+"""tools/disclint.py: the repo-discipline AST lint (doc/lint.md).
+
+Unit tests drive each rule over synthetic sources; the tree guard runs
+the real CLI over the shipped code and asserts exit 0 — a new discipline
+violation (or a regression in the linter itself) fails tier-1 here, the
+``tests/test_collect.py`` pattern applied to code discipline.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DISCLINT = os.path.join(REPO, "tools", "disclint.py")
+
+_spec = importlib.util.spec_from_file_location("disclint", DISCLINT)
+disclint = importlib.util.module_from_spec(_spec)
+sys.modules["disclint"] = disclint  # dataclasses resolve __module__
+_spec.loader.exec_module(disclint)
+
+
+def findings_for(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return disclint.lint_file(str(p))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------ the rules
+
+def test_print_rule(tmp_path):
+    hits = findings_for(tmp_path, "print('hello')\n")
+    assert rules_of(hits) == ["print"]
+
+
+def test_atomic_write_rule(tmp_path):
+    hits = findings_for(
+        tmp_path, "f = open(p, 'wb')\ng = open(p, 'r')\nh = open(p)\n")
+    assert rules_of(hits) == ["atomic-write"]
+    # keyword-mode and io.open spellings must not evade the gate
+    hits = findings_for(
+        tmp_path, "import io\n"
+                  "f = open(p, mode='w')\n"
+                  "g = io.open(p, 'a')\n"
+                  "h = open(p, mode='r')\n")
+    assert rules_of(hits) == ["atomic-write", "atomic-write"]
+
+
+def test_mktemp_rule(tmp_path):
+    hits = findings_for(
+        tmp_path, "import tempfile\np = tempfile.mktemp()\n")
+    assert rules_of(hits) == ["mktemp"]
+
+
+def test_bare_except_and_swallow_rules(tmp_path):
+    hits = findings_for(tmp_path, (
+        "try:\n    x()\nexcept:\n    pass\n"))
+    assert set(rules_of(hits)) == {"bare-except", "swallow"}
+    # a narrow except with a pass body is tolerated (cleanup idiom)
+    quiet = findings_for(tmp_path, (
+        "try:\n    x()\nexcept OSError:\n    pass\n"))
+    assert not quiet
+    # a broad except that DOES something is tolerated
+    quiet = findings_for(tmp_path, (
+        "try:\n    x()\nexcept Exception as e:\n    log(e)\n"))
+    assert not quiet
+
+
+def test_thread_exc_rule(tmp_path):
+    bad = (
+        "import threading\n"
+        "def worker():\n    run_forever()\n"
+        "t = threading.Thread(target=worker)\n")
+    assert rules_of(findings_for(tmp_path, bad)) == ["thread-exc"]
+    good = (
+        "import threading\n"
+        "def worker():\n"
+        "    try:\n        run_forever()\n"
+        "    except BaseException as e:\n        q.put(e)\n"
+        "t = threading.Thread(target=worker)\n")
+    assert not findings_for(tmp_path, good)
+    # Thread subclass run() without a try is the same contract hole
+    sub = (
+        "import threading\n"
+        "class W(threading.Thread):\n"
+        "    def run(self):\n        work()\n")
+    assert rules_of(findings_for(tmp_path, sub)) == ["thread-exc"]
+    # the from-import spelling must not evade the gate
+    bare = (
+        "from threading import Thread\n"
+        "def worker():\n    run_forever()\n"
+        "t = Thread(target=worker)\n")
+    assert rules_of(findings_for(tmp_path, bare)) == ["thread-exc"]
+
+
+def test_warn_once_rule(tmp_path):
+    bad = (
+        "from cxxnet_tpu.monitor import log as mlog\n"
+        "def f(items):\n"
+        "    for it in items:\n"
+        "        mlog.warn('x')\n")
+    assert rules_of(findings_for(tmp_path, bad)) == ["warn-once"]
+    guarded = (
+        "from cxxnet_tpu.monitor import log as mlog\n"
+        "def f(items):\n"
+        "    warned = False\n"
+        "    for it in items:\n"
+        "        if not warned:\n"
+        "            warned = True\n"
+        "            mlog.warn('x')\n")
+    assert not findings_for(tmp_path, guarded)
+    outside = (
+        "from cxxnet_tpu.monitor import log as mlog\n"
+        "def f():\n    mlog.warn('x')\n")
+    assert not findings_for(tmp_path, outside)
+
+
+# -------------------------------------------------------------- pragmas
+
+def test_pragma_same_line_and_line_above(tmp_path):
+    assert not findings_for(
+        tmp_path, "print('x')  # disclint: ok(print)\n")
+    assert not findings_for(
+        tmp_path, "# disclint: ok(print)\nprint('x')\n")
+    # pragma for a DIFFERENT rule does not suppress
+    hits = findings_for(
+        tmp_path, "print('x')  # disclint: ok(mktemp)\n")
+    assert rules_of(hits) == ["print"]
+
+
+def test_pragma_bare_ok_suppresses_all(tmp_path):
+    assert not findings_for(
+        tmp_path, "print('x')  # disclint: ok\n")
+
+
+def test_pragma_ok_file(tmp_path):
+    src = ("# disclint: ok-file(print)\n"
+           "print('a')\nprint('b')\nf = open(p, 'w')\n")
+    assert rules_of(findings_for(tmp_path, src)) == ["atomic-write"]
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    hits = findings_for(tmp_path, "def broken(:\n")
+    assert rules_of(hits) == ["parse"]
+
+
+# ------------------------------------------------------------ the guard
+
+def test_disclint_exits_zero_on_the_tree():
+    """The gate itself: every discipline violation in the shipped tree
+    is either fixed or carries an inline, auditable pragma."""
+    r = subprocess.run(
+        [sys.executable, DISCLINT, "--json"], cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+    out = json.loads(r.stdout)
+    assert r.returncode == 0, json.dumps(out["findings"], indent=2)
+    assert out["n_files"] > 50  # it actually walked the tree
+
+
+def test_disclint_cli_reports_violations(tmp_path):
+    p = tmp_path / "viol.py"
+    p.write_text("print('x')\n")
+    r = subprocess.run(
+        [sys.executable, DISCLINT, str(p)], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "print" in r.stdout

@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""disclint: AST lint for the framework's own code disciplines.
+
+Nine PRs of review passes kept re-finding the same hand-checked
+contracts; this tool makes them machine-enforced (tools/lint.sh runs it,
+tests/test_disclint.py asserts it exits 0 on the tree).  Rules:
+
+* ``print``        — direct ``print()`` outside cxxnet_tpu/monitor/log.py.
+                     All user-facing output rides the log surface
+                     (``info``/``notice``/``result``/``warn``) so
+                     ``silent = 1``, stream redirection, and pytest
+                     capture behave identically everywhere.
+* ``atomic-write`` — ``open(..., "w"/"a"/"x")`` outside
+                     utils/serializer.py.  Persistent artifacts go
+                     through ``serializer.atomic_write`` (tmp + fsync +
+                     rename) so a kill mid-write can never leave a
+                     half-written file; streams (JSONL sinks, prediction
+                     output) are deliberate exceptions — pragma them.
+* ``mktemp``       — ``tempfile.mktemp`` is a filename race; use
+                     ``mkstemp``/``NamedTemporaryFile`` or atomic_write.
+* ``bare-except``  — ``except:`` catches SystemExit/KeyboardInterrupt;
+                     name the exceptions (``except Exception`` with a
+                     reason comment at minimum).
+* ``swallow``      — a broad handler (bare/Exception/BaseException)
+                     whose body is just ``pass``/``continue`` drops the
+                     error on the floor; log it or latch it for reraise.
+* ``thread-exc``   — a ``threading.Thread`` target (or Thread subclass
+                     ``run``) without a try/except: a worker that dies
+                     silently strands its consumer.  The house contract
+                     is catch-and-enqueue with reraise on the consuming
+                     thread (io/device_prefetch.ProducerError,
+                     ckpt/writer poll()).
+* ``warn-once``    — ``mlog.warn`` inside a loop with no warn-once
+                     guard floods the log; latch with a ``_warned``
+                     flag/set (trainer._dp_warn_once pattern).
+
+Escape hatches, inline and auditable:
+
+    do_it()  # disclint: ok(print)          — this line (or line above)
+    # disclint: ok-file(print)              — whole file, one rule
+    # disclint: ok                           — this line, every rule
+
+Usage:  python tools/disclint.py [--json] [path ...]
+Default paths: cxxnet_tpu/ tools/ bench.py (repo-relative).  Exit 1 iff
+any finding survives the pragmas.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = ("cxxnet_tpu", "tools", "bench.py")
+
+#: files whose whole purpose exempts them from one rule
+RULE_EXEMPT_FILES = {
+    "print": ("cxxnet_tpu/monitor/log.py",),
+    "atomic-write": ("cxxnet_tpu/utils/serializer.py",),
+}
+
+RULES = ("print", "atomic-write", "mktemp", "bare-except", "swallow",
+         "thread-exc", "warn-once")
+
+_PRAGMA = re.compile(r"#\s*disclint:\s*(ok-file|ok)\s*(?:\(([^)]*)\))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+def _pragmas(src: str):
+    """(per-line {lineno: set(rules)}, file-wide set(rules)); an empty
+    rule list in a pragma means 'every rule'."""
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, line in enumerate(src.splitlines(), 1):
+        m = _PRAGMA.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in (m.group(2) or "").split(",")
+                 if r.strip()} or set(RULES)
+        if m.group(1) == "ok-file":
+            file_wide |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
+
+
+def _is_broad_catch(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in ([t.elts if isinstance(t, ast.Tuple) else [t]][0]):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _has_try(fn: ast.AST) -> bool:
+    return any(isinstance(n, ast.Try) for n in ast.walk(fn))
+
+
+def _thread_target_name(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            v = kw.value
+            if isinstance(v, ast.Name):
+                return v.id
+            if isinstance(v, ast.Attribute):
+                return v.attr
+    return None
+
+
+def _is_thread_ctor(fn: ast.AST) -> bool:
+    """``threading.Thread(...)`` or bare ``Thread(...)`` (from-import)."""
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "Thread" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading"
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The mode string of an ``open``/``io.open`` call opened for
+    writing — positional OR ``mode=`` keyword form — else None."""
+    fn = call.func
+    is_open = (isinstance(fn, ast.Name) and fn.id == "open") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "open"
+        and isinstance(fn.value, ast.Name) and fn.value.id == "io")
+    if not is_open:
+        return None
+    mode = call.args[1] if len(call.args) >= 2 else next(
+        (kw.value for kw in call.keywords if kw.arg == "mode"), None)
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+            and set(mode.value) & set("wax"):
+        return mode.value
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.per_line, self.file_wide = _pragmas(src)
+        self._loops: List[ast.AST] = []
+        self._ifs: List[ast.If] = []
+        # every function/method in the file by bare name (thread targets
+        # resolve through self.<name> or module <name>)
+        self.functions: Dict[str, ast.AST] = {}
+        self.rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+
+    # ------------------------------------------------------------ report
+    def _add(self, node: ast.AST, rule: str, message: str) -> None:
+        if rule in self.file_wide:
+            return
+        if any(self.rel.endswith(f) or self.rel == f
+               for f in RULE_EXEMPT_FILES.get(rule, ())):
+            return
+        line = getattr(node, "lineno", 0)
+        for ln in (line, line - 1):
+            if rule in self.per_line.get(ln, ()):
+                return
+        self.findings.append(Finding(self.rel, line, rule, message))
+
+    # ----------------------------------------------------------- visits
+    def collect_functions(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        mode = _open_write_mode(node)
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            self._add(node, "print",
+                      "direct print(); route through "
+                      "cxxnet_tpu.monitor.log (info/notice/result/warn)")
+        elif mode is not None:
+            self._add(node, "atomic-write",
+                      f"open(..., {mode!r}) bypasses "
+                      "serializer.atomic_write; a kill mid-write leaves "
+                      "a torn file (pragma deliberate streams)")
+        elif isinstance(fn, ast.Attribute) and fn.attr == "mktemp" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "tempfile":
+            self._add(node, "mktemp",
+                      "tempfile.mktemp is a filename race; use mkstemp/"
+                      "NamedTemporaryFile or serializer.atomic_write")
+        elif _is_thread_ctor(fn):
+            tname = _thread_target_name(node)
+            target = self.functions.get(tname) if tname else None
+            if target is not None and not _has_try(target):
+                self._add(node, "thread-exc",
+                          f"Thread target {tname!r} has no try/except: "
+                          "a silent worker death strands the consumer — "
+                          "catch and enqueue for reraise (ProducerError "
+                          "contract)")
+        elif isinstance(fn, ast.Attribute) and fn.attr == "warn" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("mlog", "log"):
+            if self._loops and not self._warn_guarded():
+                self._add(node, "warn-once",
+                          "mlog.warn inside a loop without a warn-once "
+                          "guard floods the log; latch with a _warned "
+                          "flag/set")
+        self.generic_visit(node)
+
+    def _warn_guarded(self) -> bool:
+        """True when an enclosing if-test mentions a warn latch."""
+        return any("warn" in ast.dump(i.test).lower() for i in self._ifs)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._add(node, "bare-except",
+                      "bare 'except:' catches SystemExit/"
+                      "KeyboardInterrupt; name the exceptions")
+        if _is_broad_catch(node) and node.body and all(
+                isinstance(s, (ast.Pass, ast.Continue))
+                for s in node.body):
+            self._add(node, "swallow",
+                      "broad except with a pass/continue body swallows "
+                      "the error; log it or latch it for reraise")
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        if "Thread" in bases:
+            run = next((n for n in node.body
+                        if isinstance(n, ast.FunctionDef)
+                        and n.name == "run"), None)
+            if run is not None and not _has_try(run):
+                self._add(run, "thread-exc",
+                          f"Thread subclass {node.name}.run has no "
+                          "try/except: a silent worker death strands "
+                          "the consumer")
+        self.generic_visit(node)
+
+    def _visit_loop(self, node) -> None:
+        self._loops.append(node)
+        self.generic_visit(node)
+        self._loops.pop()
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+
+    def visit_If(self, node: ast.If) -> None:
+        self._ifs.append(node)
+        self.generic_visit(node)
+        self._ifs.pop()
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+        return [Finding(rel, e.lineno or 0, "parse",
+                        f"syntax error: {e.msg}")]
+    linter = _Linter(path, src)
+    linter.collect_functions(tree)
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(REPO, p)
+        if os.path.isfile(full):
+            yield full
+        else:
+            for root, dirs, files in os.walk(full):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="repo-discipline AST lint (doc/lint.md)")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="files/dirs to lint (default: %s)"
+                         % " ".join(DEFAULT_PATHS))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    findings: List[Finding] = []
+    n_files = 0
+    for path in iter_py_files(args.paths or DEFAULT_PATHS):
+        n_files += 1
+        findings.extend(lint_file(path))
+    findings.sort(key=lambda f: (f.path, f.line))
+    if args.as_json:
+        json.dump({"kind": "disclint", "n_files": n_files,
+                   "exit": 1 if findings else 0,
+                   "findings": [dataclasses.asdict(f) for f in findings]},
+                  sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in findings:
+            sys.stdout.write(f.format() + "\n")
+        sys.stdout.write(
+            f"disclint: {n_files} files, {len(findings)} finding(s)\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

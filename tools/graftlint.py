@@ -5,13 +5,18 @@ The standalone CLI twin of ``task = check`` (doc/check.md): lint one or
 more ``.conf`` files against the declared-key registry and — unless
 ``--no-trace`` — abstract-trace each configured train step on CPU and
 lint the jaxpr (closure-captured constants, f64 promotions, weak-typed
-state leaves, dp-reduction escapes).  No device work, no data files.
+state leaves, dp-reduction escapes) plus the SPMD deep lint
+(collective-consistency, donation audit, dtype-flow — spmdlint.py;
+``--spmd`` forces it on, ``--no-spmd`` off, default follows each
+config's ``spmd_check`` key).  No device work, no data files.
 
-    python tools/graftlint.py [--json] [--no-trace] conf [conf ...]
+    python tools/graftlint.py [--json] [--no-trace] [--spmd|--no-spmd] \
+        conf [conf ...]
 
 Exit status: 1 iff any config produced an error-severity finding.
 ``--json`` prints one machine-readable object (schema in doc/check.md).
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 
@@ -40,6 +45,12 @@ def main() -> int:
                     help="machine-readable output (doc/check.md schema)")
     ap.add_argument("--no-trace", action="store_true",
                     help="config lint only; skip the jaxpr pass")
+    ap.add_argument("--spmd", dest="spmd", action="store_true",
+                    default=None,
+                    help="force the SPMD deep lint on (default: each "
+                         "config's spmd_check key, on)")
+    ap.add_argument("--no-spmd", dest="spmd", action="store_false",
+                    help="skip the SPMD deep lint")
     args = ap.parse_args()
 
     from cxxnet_tpu.analysis import run_check
@@ -60,7 +71,8 @@ def main() -> int:
             worst = max(worst, code)
             continue
         findings, code = run_check(pairs, path=path,
-                                   trace=not args.no_trace)
+                                   trace=not args.no_trace,
+                                   spmd=args.spmd)
         worst = max(worst, code)
         counts = {"error": 0, "warn": 0, "info": 0}
         for f in findings:

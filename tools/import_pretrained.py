@@ -27,6 +27,7 @@ Layouts line up with torch natively: conv ``wmat`` is
 (nhidden, nin) = ``torch.nn.Linear.weight``.  Shapes must match exactly
 — mismatches abort with both shapes printed.
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 import sys
 
 import numpy as np

@@ -1,19 +1,26 @@
 #!/usr/bin/env bash
 # Static-analysis gate (runs before any device work, no data files):
-#   1. graftlint over every shipped example config — zero error-severity
-#      findings required (the key registry and the configs must agree;
-#      tests/test_analysis.py mirrors this as the golden guard);
-#   2. the pytest collection guard — import breaks must not hide behind
+#   1. disclint — the repo-discipline AST lint over the framework's own
+#      code (doc/lint.md): direct prints, non-atomic writes, swallowed
+#      thread exceptions, warn-once violations.  Zero findings required;
+#      deliberate exceptions carry inline `# disclint: ok(...)` pragmas;
+#   2. graftlint --spmd over every shipped example config — zero
+#      error-severity findings required (the key registry and the
+#      configs must agree; tests/test_analysis.py mirrors this as the
+#      golden guard), including the SPMD deep lint (collective
+#      consistency, donation audit, dtype flow — doc/check.md);
+#   3. the pytest collection guard — import breaks must not hide behind
 #      tier-1's --continue-on-collection-errors;
-#   3. the run-report CLI over the checked-in metrics fixture — a schema
+#   4. the run-report CLI over the checked-in metrics fixture — a schema
 #      drift between the sink's record kinds and tools/obsv.py's parser
 #      breaks loudly here, not in the middle of a perf triage;
-#   4. the span->Perfetto exporter over the same fixture — drift in the
+#   5. the span->Perfetto exporter over the same fixture — drift in the
 #      span record or tools/spans2trace.py fails the gate the same way.
 # Companion to tools/tier1.sh (the runtime gate); see doc/check.md.
 cd "$(dirname "$0")/.." || exit 1
 set -e
-env JAX_PLATFORMS=cpu python tools/graftlint.py example/*/*.conf
+python tools/disclint.py
+env JAX_PLATFORMS=cpu python tools/graftlint.py --spmd example/*/*.conf
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only \
     -p no:cacheprovider >/dev/null
 env JAX_PLATFORMS=cpu python tools/obsv.py tests/fixtures/run_report.jsonl \

@@ -5,6 +5,7 @@ writes class-conditional images (each class = a distinct blob pattern plus
 noise) in the exact idx format the mnist iterator reads, so the full
 CLI-train path (example/MNIST/*.conf) can run and converge.
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 

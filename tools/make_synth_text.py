@@ -18,6 +18,7 @@ integer token ids — the ``tools/tok2bin.py`` input format), and with
     python tools/make_synth_text.py --out corpus.txt --docs 2000 \
         --vocab 512 --pack 4 --shard-prefix corpus_%d.tok
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 
@@ -56,9 +57,10 @@ def gen_docs(n_docs: int, vocab: int, mean_len: int, branch: int = 2,
 
 
 def write_corpus(path: str, docs) -> None:
-    with open(path, "w") as f:
-        for d in docs:
-            f.write(" ".join(str(int(t)) for t in d) + "\n")
+    from cxxnet_tpu.utils.serializer import atomic_write
+    atomic_write(path, lambda f: f.writelines(
+        (" ".join(str(int(t)) for t in d) + "\n").encode()
+        for d in docs))
 
 
 def main() -> int:

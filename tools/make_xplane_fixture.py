@@ -33,6 +33,12 @@ Run from the repo root:  python tools/make_xplane_fixture.py
 from __future__ import annotations
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.monitor import log as mlog  # noqa: E402
+from cxxnet_tpu.utils.serializer import atomic_write  # noqa: E402
 
 MS = 10 ** 9  # milliseconds -> picoseconds
 
@@ -123,9 +129,10 @@ def build() -> bytes:
 def main() -> None:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(root, "tests", "fixtures", "minimal.xplane.pb")
-    with open(path, "wb") as f:
-        f.write(build())
-    print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+    # atomic: a ctrl-C mid-regeneration must not leave a torn fixture
+    # for the whole trace-parser test suite to chase
+    atomic_write(path, lambda f: f.write(build()))
+    mlog.info(f"wrote {path} ({os.path.getsize(path)} bytes)")
 
 
 if __name__ == "__main__":

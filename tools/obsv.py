@@ -18,6 +18,7 @@ the scope paths embedded in its op metadata (TPU traces; CPU-runtime
 traces carry none — there the in-run ``layer_profile`` record, which
 joins through the compiled HLO, is the authoritative table).
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 

@@ -14,6 +14,7 @@ Usage:
       --out parts/ --prefix train --num_parts 8 [--shuffle 1] [--pack 1]
   python tools/partition_maker.py ... --makefile Gen.mk --im2bin native/im2bin
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 
@@ -21,6 +22,10 @@ import argparse
 import os
 import random
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.utils.serializer import atomic_write  # noqa: E402
 
 
 def read_list(path: str):
@@ -85,18 +90,18 @@ def main(argv=None) -> int:
     lst_paths = []
     for i, part in enumerate(parts):
         p = os.path.join(args.out, f"{args.prefix}_{i}.lst")
-        with open(p, "w") as f:
-            f.writelines(part)
+        atomic_write(p, lambda f, part=part: f.write(
+            "".join(part).encode()))
         lst_paths.append(p)
     print(f"wrote {len(parts)} shard lists under {args.out}")
 
     if args.makefile:
         bins = [p[:-4] + ".bin" for p in lst_paths]
-        with open(args.makefile, "w") as f:
-            f.write("all: " + " ".join(bins) + "\n\n")
-            for lst, bin_ in zip(lst_paths, bins):
-                f.write(f"{bin_}: {lst}\n"
-                        f"\t{args.im2bin} {lst} {args.img_root} {bin_}\n\n")
+        rules = "all: " + " ".join(bins) + "\n\n" + "".join(
+            f"{bin_}: {lst}\n"
+            f"\t{args.im2bin} {lst} {args.img_root} {bin_}\n\n"
+            for lst, bin_ in zip(lst_paths, bins))
+        atomic_write(args.makefile, lambda f: f.write(rules.encode()))
         print(f"emitted {args.makefile}; run: make -f {args.makefile} -j")
     if args.pack:
         from cxxnet_tpu.io.imbin import pack_imbin

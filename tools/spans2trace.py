@@ -23,6 +23,7 @@ to its dispatch slice.  The exporter is schema-coupled to the ``span``
 record (monitor/spans.py): tools/lint.sh runs it over the checked-in
 fixture, so drift in either breaks the lint gate, not a triage.
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 
@@ -115,8 +116,9 @@ def main(argv=None) -> int:
         return 1
     trace = build_trace(spans)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(trace, f)
+        from cxxnet_tpu.utils.serializer import atomic_write
+        atomic_write(args.out,
+                     lambda f: f.write(json.dumps(trace).encode()))
         n = len(trace["traceEvents"])
         print(f"spans2trace: wrote {n} events from {len(spans)} spans "
               f"to {args.out}", file=sys.stderr)

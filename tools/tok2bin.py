@@ -15,6 +15,7 @@ distribution (the partition_maker discipline).
 ``--vocab`` (optional) validates ids and picks the narrowest itemsize
 (uint16 when vocab <= 65536, else uint32).
 """
+# disclint: ok-file(print) — standalone CLI; stdout is the product surface
 
 from __future__ import annotations
 

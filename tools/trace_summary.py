@@ -14,6 +14,12 @@ Typical triage: run training with ``prof = /tmp/prof`` (optionally
 ``prof_start_step``/``prof_num_steps`` for an exact window), then point
 this tool at the directory.  The per-op table names the line to attack;
 ``device total`` is the bench-comparable on-chip step time.
+
+Output rides ``cxxnet_tpu.monitor.log`` (doc/lint.md: no direct
+``print`` outside the log surface — tools/disclint.py enforces it):
+the table lands on stdout via ``info``, errors on stderr via ``warn``,
+with the same stream-lookup indirection the rest of the framework gets
+(pipe redirection after import, pytest capture).
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cxxnet_tpu.monitor import log as mlog  # noqa: E402
 from cxxnet_tpu.monitor.trace import (collective_kind,  # noqa: E402
                                       comm_summary_in, find_xplane,
                                       op_totals_in, parse_xspace,
@@ -88,34 +95,34 @@ def main(argv=None) -> int:
     try:
         s = summarize(args.trace, args.top, args.plane, args.line)
     except FileNotFoundError as e:
-        print(f"trace_summary: {e}", file=sys.stderr)
+        mlog.warn(f"trace_summary: {e}")
         return 1
     if args.json:
-        print(json.dumps(s))
+        mlog.info(json.dumps(s))
         return 0
-    print(f"trace: {s['trace']}")
-    print(f"device total (XLA Modules, plane~{args.plane}): "
-          f"{s['device_total_ms']:.3f} ms")
+    mlog.info(f"trace: {s['trace']}")
+    mlog.info(f"device total (XLA Modules, plane~{args.plane}): "
+              f"{s['device_total_ms']:.3f} ms")
     if s["comm_total_ms"]:
         kinds = ", ".join(f"{k} {ms:.3f} ms x{n}"
                           for k, (ms, n) in s["comm_by_kind"].items())
-        print(f"comm total: {s['comm_total_ms']:.3f} ms "
-              f"(exposed {s['comm_exposed_ms']:.3f} ms, "
-              f"overlap_frac {s['comm_overlap_frac']:.2f}) [{kinds}]")
+        mlog.info(f"comm total: {s['comm_total_ms']:.3f} ms "
+                  f"(exposed {s['comm_exposed_ms']:.3f} ms, "
+                  f"overlap_frac {s['comm_overlap_frac']:.2f}) [{kinds}]")
     ops_total = s["ops_total_ms"] or 1e-12
-    print(f"{'total_ms':>12} {'count':>8} {'%ops':>6} {'comm':>15}  op")
+    mlog.info(f"{'total_ms':>12} {'count':>8} {'%ops':>6} {'comm':>15}  op")
     for row in s["top_ops"]:
-        print(f"{row['total_ms']:12.3f} {row['count']:8d} "
-              f"{100.0 * row['total_ms'] / ops_total:6.1f} "
-              f"{row['comm'] or '-':>15}  {row['op']}")
+        mlog.info(f"{row['total_ms']:12.3f} {row['count']:8d} "
+                  f"{100.0 * row['total_ms'] / ops_total:6.1f} "
+                  f"{row['comm'] or '-':>15}  {row['op']}")
     if s["dropped_ops"]:
-        print(f"... {s['dropped_ops']} more ops below top-{args.top} "
-              f"(--top to widen)")
+        mlog.info(f"... {s['dropped_ops']} more ops below top-{args.top} "
+                  f"(--top to widen)")
     if not s["top_ops"] and s.get("available"):
-        print(f"no events matched --plane {args.plane!r} "
-              f"--line {args.line!r}; the trace contains:")
+        mlog.info(f"no events matched --plane {args.plane!r} "
+                  f"--line {args.line!r}; the trace contains:")
         for a in s["available"]:
-            print(f"  plane {a['plane']!r}: lines {a['lines']}")
+            mlog.info(f"  plane {a['plane']!r}: lines {a['lines']}")
     return 0
 
 
