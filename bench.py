@@ -14,6 +14,7 @@ stderr using an analytic FLOP count of the traced network (2*MACs forward,
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -1309,6 +1310,51 @@ def bench_opt_ab(argv=None) -> dict:
     return payload
 
 
+def pop_against(argv):
+    """Extract ``--against PATH`` (or ``--against=PATH``) from an argv
+    list; returns ``(path_or_None, remaining_argv)``."""
+    out, path = [], None
+    it = iter(argv)
+    for a in it:
+        if a == "--against":
+            path = next(it, None)
+            if path is None or path.startswith("--"):
+                # an unset $BASELINE must not swallow the next flag as
+                # the path (silently running the wrong bench mode)
+                raise SystemExit("bench: --against needs a "
+                                 "BENCH_rNN.json path")
+        elif a.startswith("--against="):
+            path = a.split("=", 1)[1]
+            if not path:
+                # an unset $BASELINE must not silently drop the gate
+                raise SystemExit("bench: --against= needs a "
+                                 "BENCH_rNN.json path")
+        else:
+            out.append(a)
+    return path, out
+
+
+def against_verdict(payload: dict, path: str, rel: float = 0.10) -> int:
+    """``--against BENCH_rNN.json``: judge this payload against a
+    recorded round through the one comparison engine
+    (cxxnet_tpu/monitor/diff.py) — the one-command verdict a bench
+    session ends with.  Returns the process exit code: 1 on any
+    regression past ``rel``, 2 when the baseline file is missing or
+    unreadable (distinct from the regression verdict, like obsv's
+    --diff), and prints the aligned table to stderr."""
+    from cxxnet_tpu.monitor.diff import diff_bench, render_diff
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench: --against {path}: {e}", file=sys.stderr)
+        return 2
+    d = diff_bench(prior, payload, rel=rel)
+    print(render_diff(d, label_a=os.path.basename(path),
+                      label_b="this run"), file=sys.stderr)
+    return 1 if d["regressions"] else 0
+
+
 #: --flag -> mode function; each takes the remaining argv and returns
 #: the one-line JSON payload (main() owns the sink mirror + print)
 BENCH_MODES = {
@@ -1322,15 +1368,21 @@ BENCH_MODES = {
 
 
 def main() -> None:
+    # --against BENCH_rNN.json: after ANY mode (or the headline) ran,
+    # judge the payload against the recorded round and exit nonzero on
+    # regression — the BENCH_r06 protocol's one-command verdict
+    against, argv = pop_against(sys.argv[1:])
     for flag, mode in BENCH_MODES.items():
-        if flag not in sys.argv[1:]:
+        if flag not in argv:
             continue
-        payload = mode([a for a in sys.argv[1:] if a != flag])
+        payload = mode([a for a in argv if a != flag])
         try:
             emit_bench_record(payload)
         except Exception as e:  # the sink must never break the payload
             print(f"bench: metrics sink failed: {e}", file=sys.stderr)
         print(json.dumps(payload))
+        if against:
+            sys.exit(against_verdict(payload, against))
         return
     import jax
     from __graft_entry__ import ALEXNET_NET, _make_trainer
@@ -1457,6 +1509,8 @@ def main() -> None:
     except Exception as e:  # the sink must never break the headline
         print(f"bench: metrics sink failed: {e}", file=sys.stderr)
     print(json.dumps(payload))
+    if against:
+        sys.exit(against_verdict(payload, against))
 
 
 if __name__ == "__main__":

@@ -333,6 +333,22 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                 add(Finding("warn", k,
                             f"{k} has no effect without sentinel = 1"))
                 break
+    # goodput ledger (doc/monitor.md): default-on and silent when the
+    # defaults apply — only an EXPLICIT setting that cannot take effect
+    # is worth a finding
+    if "ledger" in last:
+        if _as_int(last, "ledger", 1) and not sink_on:
+            add(Finding("warn", "ledger",
+                        "ledger = 1 without metrics_sink: the "
+                        "end-of-run goodput ledger record has nowhere "
+                        "to land; set metrics_sink = jsonl:<path>"))
+        if _as_int(last, "ledger", 1) and task not in ("train",
+                                                       "finetune"):
+            # ledger = 0 off-task is a harmless no-op, not a finding
+            add(Finding("warn", "ledger",
+                        f"ledger has no effect under task = {task}: "
+                        "only train/finetune runs emit the end-of-run "
+                        "ledger record"))
     if batch_split > 1 and batch_size and batch_size % batch_split:
         add(Finding("error", "batch_split",
                     f"batch_size = {batch_size} is not divisible by "

@@ -68,6 +68,12 @@ TASK_KEYS = (
     K("sentinel_ring", "int", lo=1,
       help="flight-recorder depth: last K step records dumped on an "
            "anomaly or TrainingDiverged"),
+    # goodput ledger (monitor/ledger.py, doc/monitor.md): end-of-run
+    # wall accounting, emitted from the task finally so a diverged run
+    # still lands it; tools/obsv.py --diff compares two of them
+    K("ledger", "int", lo=0, hi=1,
+      help="emit the end-of-run goodput ledger record (default 1; "
+           "needs metrics_sink, train/finetune tasks only)"),
     K("test_on_server", "int", lo=0, hi=1),
     # OOM pre-flight (analysis/memmodel.py, doc/memory.md): task=check
     # runs the analytic memory model against the target chip's HBM
@@ -145,6 +151,13 @@ class LearnTask:
         self.sentinel_warmup = 3
         self.sentinel_ring = 64
         self._sentinel_bank = None
+        # goodput ledger (doc/monitor.md): fold the run's own records
+        # into an end-of-run wall-accounting record from run()'s finally
+        self.ledger = 1
+        self._run_t0: Optional[float] = None
+        # the sink appends: bytes already in the file at run start are
+        # an earlier session's and must not fold into THIS run's ledger
+        self._sink_offset = 0
         # fault-tolerant checkpoints (doc/checkpoint.md): ckpt_async=1
         # snapshots at round boundaries into atomic NNNN.ckpt dirs off
         # the training thread; save_opt carries optimizer state (exact
@@ -241,6 +254,8 @@ class LearnTask:
             self.sentinel_warmup = int(val)
         elif name == "sentinel_ring":
             self.sentinel_ring = int(val)
+        elif name == "ledger":
+            self.ledger = int(val)
         elif name == "ckpt_async":
             self.ckpt_async = int(val)
         elif name == "ckpt_keep":
@@ -1605,15 +1620,56 @@ class LearnTask:
             sm.close()
         mlog.notice(f"finished serving, wrote {self.name_pred}")
 
+    def _emit_ledger(self) -> None:
+        """End-of-run goodput ledger (monitor/ledger.py): re-read the
+        run's own sink file (flushed per record, so everything the run
+        emitted — including a TrainingDiverged flight dump — is on
+        disk) and fold it into one ``ledger`` record.  Called from
+        run()'s finally BEFORE the sink closes, so a diverged run still
+        lands its ledger; the same fold recomputes post-hoc in
+        ``tools/obsv.py`` for historical JSONLs that lack one."""
+        if not self.ledger or self.task not in ("train", "finetune"):
+            return
+        net = self.net
+        if net is None or not net.metrics.active or self._run_t0 is None:
+            return
+        try:
+            from .monitor import ledger as ledgerlib
+            recs = ledgerlib.load_records(net.metrics.sink.path,
+                                          who="ledger",
+                                          offset=self._sink_offset)
+            led = ledgerlib.build_ledger(
+                recs, wall_sec=time.perf_counter() - self._run_t0)
+            if led is None:
+                return
+            net.metrics.emit("ledger", **led)
+            mlog.info("ledger: " + ledgerlib.format_ledger(led))
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            mlog.warn(f"ledger emit failed: {e}")
+
     def run(self, argv: List[str]) -> int:
         if len(argv) < 1:
             mlog.notice("Usage: python -m cxxnet_tpu <config> [key=value ...]")
             return 0
+        # ledger wall starts here: init, iterator construction, and
+        # compile are all part of the run the ledger accounts for
+        self._run_t0 = time.perf_counter()
         for k, v in parse_config_file(argv[0]):
             self.set_param(k, v)
         for k, v in parse_keyval_args(argv[1:]):
             self.set_param(k, v)
         self._conf_path = argv[0]
+        # anchor the ledger at the sink's current size: the JSONL sink
+        # appends, so a reused path still carries earlier sessions —
+        # even ones killed before their own ledger record could bound
+        # them (build_ledger's last-ledger slice covers the clean case)
+        spec = dict(self.cfg).get("metrics_sink", "")
+        if spec.startswith("jsonl:"):
+            sink_path = spec[len("jsonl:"):]
+            try:
+                self._sink_offset = os.path.getsize(sink_path)
+            except OSError:
+                self._sink_offset = 0
         if self.task == "check":
             # lint-only: no iterators, no device, no data files
             return self.task_check()
@@ -1651,7 +1707,10 @@ class LearnTask:
             # records) ran — a TrainingDiverged or mid-round iterator
             # failure must still land its final records and must not
             # leak the descriptor past the task (the PR-4 prefetcher
-            # leak class, applied to telemetry)
+            # leak class, applied to telemetry).  The goodput ledger is
+            # the run's LAST record: it folds everything above it,
+            # including the exception path's flight dump
+            self._emit_ledger()  # guards its own failures
             if self.net is not None:
                 self.net.metrics.close()
         return 0

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import random
 import threading
 import time
@@ -96,9 +97,22 @@ class Histogram:
 class JsonlSink:
     def __init__(self, path: str):
         self.path = path
+        # a predecessor killed mid-write leaves a torn, newline-less
+        # tail; appending straight after it would glue THIS run's first
+        # record onto the torn line and lose both — restore the line
+        # boundary before the first write
+        torn = False
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except (OSError, ValueError):
+            pass  # missing or empty file: nothing to repair
         # append-only stream by design (torn tails are tolerated by
         # every JSONL reader here; atomic_write would buffer the run)
         self._fo: TextIO = open(path, "a")  # disclint: ok(atomic-write)
+        if torn:
+            self._fo.write("\n")
         # the async checkpoint writer emits its `ckpt` record from the
         # writer thread while the train loop emits step records; a
         # buffered TextIOWrapper is not thread-safe, so serialize writes

@@ -5,7 +5,10 @@ session-comparable number) in each round's ``BENCH_r*.json``; BASELINE.md
 records the accepted number.  Nothing previously GATED on the two
 agreeing, so a lowering change that silently regressed device time would
 only surface when a human re-read the tables.  This module compares the
-newest bench record against the baseline with a ±10% budget.
+newest bench record against the baseline with a ±10% budget, routed
+through the ONE comparison engine (``cxxnet_tpu/monitor/diff.py`` — the
+same verdict ``tools/obsv.py --diff`` and ``bench.py --against`` use),
+so exactly one threshold/comparison implementation exists.
 
 Marked ``slow``: it is excluded from the tier-1 CPU suite (the JSONs are
 produced on TPU sessions; a CPU checkout may carry stale ones) and meant
@@ -50,6 +53,7 @@ def _baseline_device_ms():
 
 @pytest.mark.slow
 def test_device_step_within_budget():
+    from cxxnet_tpu.monitor.diff import LOWER_BETTER, compare
     rec = json.loads(_newest_bench().read_text())
     parsed = rec.get("parsed") or {}
     dev = parsed.get("device_step_ms")
@@ -57,12 +61,14 @@ def test_device_step_within_budget():
         pytest.skip(f"{_newest_bench().name} has no device_step_ms "
                     "(trace failed that session)")
     base = _baseline_device_ms()
-    assert dev <= base * (1.0 + BUDGET), (
+    verdict = compare("device_step_ms", base, dev, rel=BUDGET,
+                      direction=LOWER_BETTER)
+    assert not verdict["regressed"], (
         f"device_step_ms regressed: {dev:.2f} ms vs baseline {base:.2f} ms "
-        f"(+{(dev / base - 1) * 100:.1f}%, budget +{BUDGET * 100:.0f}%) — "
+        f"({verdict['rel_delta']:+.1%}, budget +{BUDGET * 100:.0f}%) — "
         "either find the regression or re-baseline BASELINE.md with the "
         "explanation")
     # a big IMPROVEMENT is also a finding: it means BASELINE.md is stale
-    if dev < base * (1.0 - BUDGET):
+    if verdict["improved"]:
         pytest.skip(f"device_step_ms improved past the budget "
                     f"({dev:.2f} vs {base:.2f} ms) — update BASELINE.md")

@@ -203,6 +203,10 @@ ROUND_KEYS = {"ts", "kind", "round", "wall_sec", "eval_sec", "examples",
               "examples_per_sec", "iter_wait_sec", "dispatch_sec",
               "h2d_sec", "train_step_traces", "eval_step_traces",
               "train-error", "val-error"}
+LEDGER_KEYS = {"ts", "kind", "wall_sec", "categories", "shares",
+               "goodput_pct", "h2d_overlapped_sec", "rounds",
+               "rounds_lost", "rollbacks", "anomalies",
+               "nonfinite_steps", "source"}
 
 
 def _run_cli(tmp_path, extra_cfg="", num_round=2):
@@ -251,7 +255,8 @@ metrics_sink = jsonl:{sink}
     by_kind = {}
     for r in recs:
         by_kind.setdefault(r["kind"], []).append(r)
-    assert set(by_kind) == {"run", "compile", "step", "round", "monitor"}
+    assert set(by_kind) == {"run", "compile", "step", "round", "monitor",
+                            "ledger"}
     run = by_kind["run"][0]
     assert run["batch_size"] == 16 and run["updater"] == "sgd"
     assert "pool_bwd" in run["engine_opts"]
@@ -266,6 +271,14 @@ metrics_sink = jsonl:{sink}
     layers = {r["layer"] for r in by_kind["monitor"]}
     assert layers == {"00-fc1/wmat", "00-fc1/bias",
                       "02-fc2/wmat", "02-fc2/bias"}
+    # the end-of-run goodput ledger is the stream's LAST record and
+    # carries the documented schema (doc/monitor.md; the deep fold is
+    # covered in tests/test_ledger.py)
+    (ledger,) = by_kind["ledger"]
+    assert recs[-1]["kind"] == "ledger"
+    assert set(ledger) == LEDGER_KEYS, ledger
+    assert set(ledger["categories"]) == set(ledger["shares"])
+    assert ledger["source"] == "run"
     assert len(by_kind["round"]) == 2
     first, second = by_kind["round"]
     assert set(first) == ROUND_KEYS | {"compile_sec"}, first

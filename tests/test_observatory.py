@@ -416,8 +416,11 @@ metrics_sink = jsonl:{sink}
     assert "TrainingDiverged" in flights[0]["reason"]
     assert flights[0]["n_records"] >= 1
     assert all(r["kind"] == "step" for r in flights[0]["records"])
-    # the flight dump is the LAST record: teardown closed the sink after
-    assert kinds[-1] == "flight"
+    # the flight dump is the last record of the EXCEPTION path; the
+    # task-finally goodput ledger folds it and lands after (the
+    # stream's true last record), then teardown closed the sink
+    assert kinds[-1] == "ledger"
+    assert kinds[-2] == "flight"
     assert task.net.metrics.sink is None  # closed, not leaked
 
 

@@ -15,7 +15,10 @@
 #      drift between the sink's record kinds and tools/obsv.py's parser
 #      breaks loudly here, not in the middle of a perf triage;
 #   5. the span->Perfetto exporter over the same fixture — drift in the
-#      span record or tools/spans2trace.py fails the gate the same way.
+#      span record or tools/spans2trace.py fails the gate the same way;
+#   6. the cross-run comparator self-diffed over the fixture — a run
+#      must never regress against itself (exit 0, zero regressions), so
+#      drift in the diff engine or the ledger fold fails here.
 # Companion to tools/tier1.sh (the runtime gate); see doc/check.md.
 cd "$(dirname "$0")/.." || exit 1
 set -e
@@ -28,4 +31,8 @@ env JAX_PLATFORMS=cpu python tools/obsv.py tests/fixtures/run_report.jsonl \
 env JAX_PLATFORMS=cpu python tools/spans2trace.py \
     tests/fixtures/run_report.jsonl | python -c \
     'import json,sys; t=json.load(sys.stdin); assert t["traceEvents"]'
+env JAX_PLATFORMS=cpu python tools/obsv.py --diff \
+    tests/fixtures/run_report.jsonl tests/fixtures/run_report.jsonl \
+    --json | python -c \
+    'import json,sys; d=json.load(sys.stdin); assert d["regressions"] == 0'
 echo "lint OK"

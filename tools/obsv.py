@@ -1,17 +1,30 @@
 #!/usr/bin/env python
-"""obsv: one-command run report from a metrics JSONL (+ optional trace).
+"""obsv: run report, cross-run diff, and live follow over metrics JSONLs.
 
 The training observatory's read side (doc/monitor.md "Reading a run
 report"): point it at the ``metrics_sink`` file of any run and get the
-throughput trend, the compile/comm/idle breakdown, the top-k layers by
-attributed device time with roofline distance, inference latency
-percentiles, and every anomaly the sentinels fired — as aligned
-terminal tables or one ``--json`` object for CI.
+throughput trend, the goodput ledger, the compile/comm/idle breakdown,
+the top-k layers by attributed device time with roofline distance,
+inference latency percentiles, and every anomaly the sentinels fired —
+as aligned terminal tables or one ``--json`` object for CI.
 
     python tools/obsv.py metrics.jsonl
     python tools/obsv.py metrics.jsonl --json | jq .layers
     python tools/obsv.py metrics.jsonl --top 20
     python tools/obsv.py metrics.jsonl --trace /tmp/prof   # re-attribute
+    python tools/obsv.py --diff A.jsonl B.jsonl            # CI gate
+    python tools/obsv.py metrics.jsonl --follow            # live tail
+
+``--diff`` aligns two runs through the one comparison engine
+(cxxnet_tpu/monitor/diff.py) and **exits 1 on any regression** past
+``--rel`` (default 10%) — wire it into CI, don't read it by hand.
+``--follow`` tails a growing file (train or serve), re-renders as
+records land, tolerates the torn final line of a mid-write file, and
+flags ``anomaly``/``flight``/``nan``/``rollback`` records immediately;
+it exits on its own when the watched run's ``ledger`` record lands at
+the end of the stream.  Records already present at start (a reused
+append-mode sink, including the previous session's ledger) are
+catch-up context, never terminal.
 
 ``--trace`` re-runs layer attribution directly on a profiler trace via
 the scope paths embedded in its op metadata (TPU traces; CPU-runtime
@@ -26,35 +39,30 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load_records(path: str) -> List[dict]:
-    recs = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                r = json.loads(line)
-            except ValueError:
-                continue  # torn tail line from a killed run
-            if isinstance(r, dict) and "kind" in r:
-                recs.append(r)
-    return recs
+    """Tolerant JSONL read — the one shared implementation
+    (cxxnet_tpu/monitor/ledger.py): a torn final line from a killed run
+    is skipped with a one-shot warning, never a JSONDecodeError."""
+    from cxxnet_tpu.monitor.ledger import load_records as _load
+    return _load(path, who="obsv")
 
 
 def _by_kind(recs: List[dict]) -> Dict[str, List[dict]]:
-    out: Dict[str, List[dict]] = {}
-    for r in recs:
-        out.setdefault(r["kind"], []).append(r)
-    return out
+    from cxxnet_tpu.monitor.ledger import by_kind
+    return by_kind(recs)
 
 
 def build_report(recs: List[dict], top: int = 10) -> dict:
+    # an append-mode sink carries earlier sessions; the report (like
+    # the diff) describes the LAST one — the session its ledger bounds
+    from cxxnet_tpu.monitor.ledger import last_session
+    recs = last_session(recs)
     by = _by_kind(recs)
     rep: dict = {"n_records": len(recs),
                  "kinds": {k: len(v) for k, v in sorted(by.items())}}
@@ -103,6 +111,18 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
             "other_sec": round(max(wall - disp - wait, 0.0), 3),
             "compile_sec": rep.get("compile_sec"),
         }
+
+    # goodput ledger: the emitted end-of-run record when present, else
+    # recomputed post-hoc from the stream — the same fold either way
+    # (monitor/ledger.py), so historical JSONLs get the same accounting
+    if by.get("ledger"):
+        rep["ledger"] = {k: v for k, v in by["ledger"][-1].items()
+                         if k not in ("ts", "kind")}
+    elif steps or rounds:
+        from cxxnet_tpu.monitor.ledger import build_ledger
+        led = build_ledger(recs, source="posthoc")
+        if led:
+            rep["ledger"] = led
 
     if by.get("trace"):
         t = by["trace"][-1]
@@ -252,6 +272,29 @@ def render(rep: dict) -> str:
                    f"h2d {_fmt(bd['h2d_sec'])} s, "
                    f"eval {_fmt(bd['eval_sec'])} s, "
                    f"compile {_fmt(bd.get('compile_sec'))} s")
+    led = rep.get("ledger")
+    if led:
+        out.append("")
+        src = "" if led.get("source") == "run" else \
+            f" [{led.get('source')}]"
+        line = (f"goodput{src}: {_fmt(led.get('goodput_pct'), 2)}% of "
+                f"{_fmt(led.get('wall_sec'))} s wall")
+        if led.get("h2d_overlapped_sec"):
+            line += (f"; h2d overlapped "
+                     f"{_fmt(led['h2d_overlapped_sec'])} s (off the "
+                     "critical path)")
+        if led.get("rounds_lost"):
+            line += (f"; {led['rounds_lost']} round(s) lost to "
+                     f"{led.get('rollbacks')} rollback(s)")
+        out.append(line)
+        from cxxnet_tpu.monitor.ledger import CATEGORIES
+        cats = led.get("categories") or {}
+        shares = led.get("shares") or {}
+        out.append(_table(
+            ["category", "sec", "share"],
+            [[c, _fmt(cats.get(c)),
+              (f"{shares[c]:.1%}" if c in shares else "-")]
+             for c in CATEGORIES if cats.get(c) is not None]))
     rounds = rep.get("rounds")
     if rounds:
         out.append("")
@@ -451,10 +494,156 @@ def trace_report(path: str, top: int) -> dict:
             "comm": comm_report_in(planes), "layers": table}
 
 
+# ------------------------------------------------------------ live follow
+
+class Follower:
+    """Incremental tail of a growing metrics JSONL (``--follow``).
+
+    ``poll()`` reads whatever landed since the last call and returns
+    ``(new_records, alerts)``.  The torn final line of a mid-write file
+    stays buffered until its newline arrives — a record split across
+    two polls parses once, whole.  Alerts are the record kinds an
+    operator wants flagged the moment they land."""
+
+    ALERT_KINDS = ("anomaly", "flight", "nan", "rollback")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.records: List[dict] = []
+        self._pos = 0
+        self._buf = ""
+
+    def poll(self):
+        try:
+            with open(self.path) as f:
+                f.seek(self._pos)
+                chunk = f.read()
+                self._pos = f.tell()
+        except FileNotFoundError:
+            return [], []
+        if not chunk:
+            return [], []
+        self._buf += chunk
+        lines = self._buf.split("\n")
+        self._buf = lines.pop()  # the torn tail ("" after a whole line)
+        from cxxnet_tpu.monitor.ledger import parse_record_line
+        new: List[dict] = []
+        for line in lines:
+            try:
+                r = parse_record_line(line)  # the one shared parse
+            except ValueError:
+                continue  # a complete-but-broken line: skip, don't die
+            if r is not None:
+                new.append(r)
+        self.records.extend(new)
+        return new, [r for r in new if r["kind"] in self.ALERT_KINDS]
+
+
+def _alert_line(r: dict) -> str:
+    k = r.get("kind")
+    if k == "anomaly":
+        body = (f"{r.get('metric')} {r.get('direction')} to "
+                f"{_fmt(r.get('value'))} (ewma {_fmt(r.get('ewma'))}, "
+                f"rel_dev {_fmt(r.get('rel_dev'))})")
+    elif k == "flight":
+        body = (f"{r.get('n_records')} step record(s) dumped: "
+                f"{r.get('reason')}")
+    elif k == "nan":
+        body = f"non-finite loss at round {r.get('round')} " \
+               f"step {r.get('step')} ({r.get('action')})"
+    elif k == "rollback":
+        body = (f"retry {r.get('retry')}/{r.get('max_retry')}: restored "
+                f"round {r.get('restored_round')} ({r.get('reason')})")
+    else:
+        body = json.dumps({k2: v for k2, v in r.items() if k2 != "ts"})
+    return f"!! {k}: {body}"
+
+
+def follow(path: str, interval: float = 1.0, top: int = 10,
+           ticks: int = 0, out=None) -> int:
+    """Tail ``path``: re-render the report whenever new records land,
+    print alert lines immediately, stop when the watched run's
+    end-of-run ``ledger`` record lands (or after ``ticks`` polls, the
+    CI bound).
+
+    Records already in the file when the follow starts are CATCH-UP
+    context: rendered and alert-flagged, but never terminal — a reused
+    append-mode sink ends with the *previous* session's ledger, and
+    exiting on it would abandon the live run during its first compile.
+    Only a ledger that arrives at the end of the stream on a later
+    poll ends the follow.
+
+    Each re-render rebuilds the report over the whole accumulated
+    stream — O(records) per poll, bounded in cadence by ``interval``;
+    at sink cadences (print_step / round / window records) that is
+    milliseconds even for day-long streams."""
+    out = out or sys.stdout
+    color = hasattr(out, "isatty") and out.isatty()
+    f = Follower(path)
+    n = 0
+    try:
+        while True:
+            new, alerts = f.poll()
+            for a in alerts:
+                line = _alert_line(a)
+                if color:
+                    line = f"\x1b[31m{line}\x1b[0m"
+                print(line, file=out, flush=True)
+            if new:
+                rep = build_report(f.records, top=top)
+                print(f"\n--- {path}: {len(f.records)} record(s) ---",
+                      file=out)
+                print(render(rep), file=out, flush=True)
+            if new and new[-1].get("kind") == "ledger":
+                if n == 0:
+                    print("\n(stream already ends with a ledger — a "
+                          "finished run; watching for a new session "
+                          "to append)", file=out, flush=True)
+                else:
+                    print("\nrun ended (ledger record landed); "
+                          "follow exiting", file=out)
+                    return 0
+            n += 1
+            if ticks and n >= ticks:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+# ------------------------------------------------------------------- diff
+
+def run_diff(path_a: str, path_b: str, rel: float,
+             as_json: bool) -> int:
+    """``--diff A B``: the CI gate — exit 1 on any regression of B
+    (candidate) vs A (baseline) past ``rel`` (monitor/diff.py)."""
+    from cxxnet_tpu.monitor.diff import diff_runs, render_diff
+    try:
+        recs_a, recs_b = load_records(path_a), load_records(path_b)
+    except (OSError, ValueError) as e:
+        # ValueError covers UnicodeDecodeError: a binary/corrupt input
+        # must exit 2 (unreadable), never 1 (the regression verdict)
+        print(f"obsv: {e}", file=sys.stderr)
+        return 2
+    for path, recs in ((path_a, recs_a), (path_b, recs_b)):
+        if not recs:
+            print(f"obsv: no records in {path}", file=sys.stderr)
+            return 2
+    d = diff_runs(recs_a, recs_b, rel=rel)
+    if as_json:
+        print(json.dumps(d))
+    else:
+        print(render_diff(d, label_a=os.path.basename(path_a),
+                          label_b=os.path.basename(path_b)))
+    return 1 if d["regressions"] else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="run report from a metrics JSONL (+ optional trace)")
-    ap.add_argument("jsonl", help="metrics_sink JSONL file")
+        description="run report / cross-run diff / live follow over "
+                    "metrics JSONLs")
+    ap.add_argument("jsonl", nargs="?", default="",
+                    help="metrics_sink JSONL file")
     ap.add_argument("--trace", default="",
                     help="profiler log dir / xplane.pb: re-attribute "
                     "per-layer device time from the trace's own scope "
@@ -463,7 +652,32 @@ def main(argv=None) -> int:
                     help="layer rows to show")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON object instead of tables")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="compare run B (candidate) against run A "
+                    "(baseline); exits 1 on any regression past --rel")
+    ap.add_argument("--rel", type=float, default=0.10,
+                    help="relative regression threshold for --diff "
+                    "(default 0.10)")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail a growing metrics JSONL: re-render as "
+                    "records land, flag anomaly/flight/nan/rollback "
+                    "immediately, exit when the watched run's ledger "
+                    "record lands (pre-existing records are catch-up, "
+                    "never terminal)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll interval in seconds")
+    ap.add_argument("--follow-ticks", type=int, default=0,
+                    help="--follow: stop after N polls (0 = until the "
+                    "ledger record or Ctrl-C; CI smoke uses a bound)")
     args = ap.parse_args(argv)
+    if args.diff:
+        return run_diff(args.diff[0], args.diff[1], rel=args.rel,
+                        as_json=args.json)
+    if not args.jsonl:
+        ap.error("a metrics JSONL is required (or use --diff A B)")
+    if args.follow:
+        return follow(args.jsonl, interval=args.interval, top=args.top,
+                      ticks=args.follow_ticks)
     try:
         recs = load_records(args.jsonl)
     except OSError as e:
