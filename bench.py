@@ -1205,6 +1205,170 @@ def bench_lm(argv=None) -> dict:
     }
 
 
+def bench_lm_serve(argv=None) -> dict:
+    """``--lm-serve``: offered-load sweep over the incremental-decode
+    serving path (serve/decode.py + StepScheduler, doc/serve.md
+    "Incremental decode").  A tiny transformer LM serves generation
+    requests with MIXED target lengths through the KV-cache engine;
+    per offered-load point (``clients`` concurrent submitters) the
+    payload reports aggregate tokens/sec, per-token step latency
+    p50/p95/p99, and the batch-occupancy histogram.  The headline is
+    the continuous-vs-request A/B at the highest load: token-level
+    admission refills a freed cache slot between decode steps, so the
+    short generations in a mixed batch never wait on the longest one —
+    ``speedup_continuous`` is that win, and ``retraces`` must stay 0
+    across the whole sweep (two executables, PR 8 contract).
+
+    ``key=value`` overrides: ``dev`` (default cpu), ``slots``,
+    ``seqlen``, ``requests``, ``clients`` (csv sweep), ``prompt``,
+    ``gen_tokens``; ``--tiny``/``tiny=1`` shrinks everything for CI
+    smoke."""
+    import threading
+
+    args = dict(a.split("=", 1) for a in (argv or []) if "=" in a)
+    tiny = args.get("tiny") == "1" or "--tiny" in (argv or [])
+    dev = args.get("dev", "cpu")
+    if dev == "cpu":
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    from cxxnet_tpu.models import transformer
+    from cxxnet_tpu.serve.batcher import StepScheduler
+    from cxxnet_tpu.serve.decode import DecodeEngine
+    from __graft_entry__ import _make_trainer
+
+    if tiny:
+        vocab, seqlen, dim, nlayer, nhead = 64, 32, 32, 1, 2
+        slots, requests, client_list, cap = 2, 6, [2], 8
+        trials = 1
+    else:
+        # dim 192 keeps the per-step device work well above the
+        # Python dispatch+sampling overhead, so the A/B measures
+        # scheduling policy, not interpreter noise
+        vocab, seqlen, dim, nlayer, nhead = 512, 128, 192, 2, 4
+        slots, requests, client_list, cap = 4, 48, [1, 4, 8], 24
+        trials = 3
+    trials = int(args.get("trials", trials))
+    slots = int(args.get("slots", slots))
+    seqlen = int(args.get("seqlen", seqlen))
+    requests = int(args.get("requests", requests))
+    cap = int(args.get("gen_tokens", cap))
+    if "clients" in args:
+        client_list = [int(c) for c in args["clients"].split(",") if c]
+    prompt_len = int(args.get("prompt", max(4, seqlen // 8)))
+    prompt_len = min(prompt_len, max(1, seqlen - cap))
+
+    t = _make_trainer(
+        transformer(vocab=vocab, seq=seqlen, dim=dim, nlayer=nlayer,
+                    nhead=nhead),
+        slots, dev, extra=[("updater", "sgd"), ("eta", "0.01"),
+                           ("eval_train", "0"), ("silent", "1")])
+    engine = DecodeEngine(t, slots=slots, max_seqlen=seqlen,
+                          metrics=t.metrics)
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_sec = time.perf_counter() - t0
+    rnd = np.random.RandomState(0)
+    prompts = [rnd.randint(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(requests)]
+    # mixed generation lengths — the workload where request-level
+    # batching head-of-line blocks on the longest sequence per batch
+    mix = [cap, max(2, cap // 4), max(3, cap // 2), cap]
+    lens = [mix[i % len(mix)] for i in range(requests)]
+
+    def run_arm(continuous, clients):
+        sched = StepScheduler(engine, max_new_tokens=cap, eos=-1,
+                              sample="greedy",
+                              queue_depth=requests + 1,
+                              continuous=continuous, metrics=t.metrics,
+                              name="bench")
+        sched.start()
+        lock = threading.Lock()
+        idx = [0]
+        errs = []
+        t_start = time.perf_counter()
+
+        def client():
+            while True:
+                with lock:
+                    i = idx[0]
+                    if i >= requests:
+                        return
+                    idx[0] += 1
+                try:
+                    sched.submit(prompts[i], max_new_tokens=lens[i])
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t_start
+        st = sched.stats()
+        sched.close()
+        if errs:
+            raise errs[0]
+        st["tokens_per_sec"] = round(st["tokens"] / max(wall, 1e-9), 1)
+        st["wall_sec"] = round(wall, 3)
+        return st
+
+    # throwaway warm pass: the first executions after AOT compile pay
+    # one-time runtime setup that would bias whichever arm runs first
+    run_arm(True, min(2, max(1, min(client_list))))
+
+    points = []
+    for clients in client_list:
+        st = run_arm(True, clients)
+        points.append({"clients": clients, **st})
+        print(f"bench: lm-serve clients={clients} -> "
+              f"{st['tokens_per_sec']} tok/s "
+              f"p50={st.get('tok_p50_ms', 0)}ms "
+              f"p99={st.get('tok_p99_ms', 0)}ms "
+              f"occ={st['mean_occupancy']}", file=sys.stderr)
+    # continuous-vs-request A/B at the highest offered load: same
+    # engine, same prompts, same mixed lengths — only admission
+    # differs.  Interleaved fresh trials, median tokens/sec per arm
+    # (run-order and thread-scheduling noise at sub-ms step times
+    # otherwise swamps the policy effect)
+    hi = max(client_list)
+    cont_runs, req_runs = [], []
+    for _ in range(max(1, trials)):
+        cont_runs.append(run_arm(True, hi))
+        req_runs.append(run_arm(False, hi))
+    med = (lambda runs: sorted(
+        runs, key=lambda s: s["tokens_per_sec"])[len(runs) // 2])
+    ab = {"continuous": dict(med(cont_runs), clients=hi),
+          "request": dict(med(req_runs), clients=hi)}
+    cont_ts = ab["continuous"]["tokens_per_sec"]
+    req_ts = ab["request"]["tokens_per_sec"]
+    speedup = round(cont_ts / max(req_ts, 1e-9), 3)
+    print(f"bench: lm-serve A/B continuous {cont_ts} vs request "
+          f"{req_ts} tok/s -> speedup {speedup} "
+          f"(retraces {engine.retraces})", file=sys.stderr)
+    return {
+        "metric": "lm_serve_tokens_per_sec",
+        "value": cont_ts,
+        "unit": "tokens/sec",
+        "slots": slots,
+        "max_seqlen": seqlen,
+        "prompt_len": prompt_len,
+        "gen_tokens": cap,
+        "requests": requests,
+        "warmup_sec": round(warmup_sec, 3),
+        "retraces": engine.retraces,
+        "kv_cache_bytes": engine.kv_cache_bytes(),
+        "points": points,
+        "ab": ab,
+        "speedup_continuous": speedup,
+    }
+
+
 OPT_AB_ARMS = {
     # arm -> engine/config pairs on top of the flagship transformer
     # (the owed BENCH_r06 session: fused_update and pallas_ln A/Bs,
@@ -1364,6 +1528,7 @@ BENCH_MODES = {
     "--io-ab": bench_io_ab,
     "--serve": bench_serve,
     "--lm": bench_lm,
+    "--lm-serve": bench_lm_serve,
 }
 
 

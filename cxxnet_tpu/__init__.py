@@ -5,12 +5,32 @@ Public surface:
 * config-file driven CLI: ``python -m cxxnet_tpu config.conf key=val ...``
 * :class:`cxxnet_tpu.nnet.trainer.NetTrainer` — the INetTrainer equivalent
 * :mod:`cxxnet_tpu.wrapper` — numpy-facing Net / DataIter / train API
+
+The top-level names resolve lazily (PEP 562): importing the package must
+NOT pull in jax, so jax-free consumers — ``tools/obsv.py``'s record
+paths, the monitor submodules they read — stay fast (~2.7 s of jax
+import otherwise, paid on EVERY CLI invocation).  Asserted by
+tests/test_tools.py's subprocess test.
 """
 
 __version__ = "0.1.0"
 
-from .nnet.trainer import NetTrainer
-from .nnet.netconfig import NetConfig
-from .io.factory import create_iterator
-
 __all__ = ["NetTrainer", "NetConfig", "create_iterator", "__version__"]
+
+_LAZY = {
+    "NetTrainer": ("cxxnet_tpu.nnet.trainer", "NetTrainer"),
+    "NetConfig": ("cxxnet_tpu.nnet.netconfig", "NetConfig"),
+    "create_iterator": ("cxxnet_tpu.io.factory", "create_iterator"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

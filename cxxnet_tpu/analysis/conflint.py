@@ -395,6 +395,7 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
     _serve_rules(last, task, add)
     _ckpt_rules(last, task, monitor, add)
     _text_rules(pairs, last, layer_types, add)
+    _decode_rules(pairs, last, layer_types, task, add)
     _mem_rules(last, task, add)
 
 
@@ -673,6 +674,154 @@ def _text_rules(pairs: ConfigPairs, last: Dict[str, str],
                     "segment_key: cross-document attention leaks across "
                     "packed rows; set segment_key = <segment field> "
                     "(doc/io.md)"))
+
+
+#: keys the incremental-decode path consumes (serve/decode.py); the
+#: first one present off-task carries the "no effect" warn
+_DECODE_KEYS = ("serve_gen", "decode_slots", "decode_max_seqlen",
+                "serve_gen_tokens", "serve_gen_sample", "serve_gen_temp",
+                "serve_gen_topk", "serve_gen_seed", "serve_gen_eos",
+                "serve_gen_prompt", "serve_gen_batching")
+
+
+def _decode_rules(pairs: ConfigPairs, last: Dict[str, str],
+                  layer_types: List[str], task: str, add) -> None:
+    """Cross-key rules for KV-cache incremental decode (serve/decode.py,
+    doc/serve.md "Incremental decode"):
+
+    * decode/generation keys without ``task = serve`` warn (first
+      match), and ``decode_*``/``serve_gen_*`` detail keys without
+      ``serve_gen = 1`` warn — they configure a path that never runs;
+    * ``serve_gen = 1`` needs an LM netconfig — embedding + attention +
+      softmax_seq — and every attention layer ``causal = 1`` (the cache
+      is append-only; a bidirectional layer would need future
+      positions);
+    * ``decode_max_seqlen`` must equal the netconfig input width (the
+      prefill executable runs the net at its declared width) and any
+      packseq ``seqlen`` — both mismatches are errors before a compile;
+    * the KV cache (2 x layers x slots x seqlen x dim x dtype) over the
+      selected chip's HBM capacity is the same pre-flight rejection
+      ``task=check``'s memory pass makes for train steps (doc/memory.md)
+      — surfaced analytically here, no trace needed;
+    * sampling detail keys that the selected ``serve_gen_sample`` kind
+      ignores warn.
+    """
+    gen = _as_int(last, "serve_gen", 0)
+    if task != "serve":
+        for k in _DECODE_KEYS:
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect without task = serve"))
+                break
+        return
+    if not gen:
+        for k in _DECODE_KEYS[1:]:
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect without serve_gen = 1"))
+                break
+        return
+    # --- LM netconfig structure: walk the layer keys positionally (the
+    # _text_rules discipline) for causal flags and the embedding dim
+    cur_layer = ""
+    n_attention = 0
+    n_causal = 0
+    embed_dim = None
+    for name, val in pairs:
+        if name.startswith("layer["):
+            cur_layer = val.split(":", 1)[0]
+            if cur_layer == "attention":
+                n_attention += 1
+            continue
+        if cur_layer == "attention" and name == "causal" \
+                and val.strip() == "1":
+            n_causal += 1
+        elif cur_layer == "embedding" and name == "nhidden":
+            try:
+                embed_dim = int(val)
+            except ValueError:
+                pass  # type error already reported by the KeySpec
+    missing = [t for t in ("embedding", "attention", "softmax_seq")
+               if t not in layer_types]
+    if layer_types and missing:
+        add(Finding("error", "serve_gen",
+                    "serve_gen = 1 needs an LM netconfig but the net "
+                    f"has no {'/'.join(missing)} layer(s); incremental "
+                    "decode only speaks token-id transformers "
+                    "(doc/serve.md)"))
+        return
+    if n_attention and n_causal < n_attention:
+        add(Finding("error", "causal",
+                    f"serve_gen = 1 but {n_attention - n_causal} of "
+                    f"{n_attention} attention layer(s) are not "
+                    "causal = 1: the KV cache is append-only, so "
+                    "bidirectional attention cannot decode "
+                    "incrementally"))
+    # --- cache geometry vs the declared input width / packseq seqlen
+    in_width = None
+    in_shape = last.get("input_shape", "")
+    if in_shape:
+        try:
+            in_width = int(in_shape.split(",")[-1])
+        except ValueError:
+            pass
+    max_seqlen = _as_int(last, "decode_max_seqlen", 0)
+    if max_seqlen:
+        if in_width is not None and max_seqlen != in_width:
+            add(Finding("error", "decode_max_seqlen",
+                        f"decode_max_seqlen = {max_seqlen} but the "
+                        f"netconfig input width is {in_width}; the "
+                        "prefill executable runs the net at its "
+                        "declared width, so the two must match"))
+        sl = _as_int(last, "seqlen", 0)
+        if sl and max_seqlen != sl:
+            add(Finding("error", "decode_max_seqlen",
+                        f"decode_max_seqlen = {max_seqlen} but the "
+                        f"packer's seqlen is {sl}; prompts tokenized "
+                        "at one length cannot fill a cache sized for "
+                        "another"))
+    # --- KV-cache HBM pre-flight (doc/memory.md): the analytic bytes
+    # the live engine's footprint() reports, checked against the
+    # selected chip's capacity without tracing anything
+    eff_seqlen = max_seqlen or in_width
+    if n_attention and embed_dim and eff_seqlen:
+        from .costmodel import HBM_BYTES, resolve_chip
+        chip = resolve_chip(last.get("mem_chip", "")
+                            or last.get("dev", ""))
+        if chip is not None:
+            cap = HBM_BYTES[chip]
+            slots = _as_int(last, "decode_slots", 4)
+            itemsize = 2 if last.get("dtype", "") == "bfloat16" else 4
+            kv = 2 * n_attention * slots * eff_seqlen * embed_dim \
+                * itemsize
+            if kv > cap:
+                add(Finding("error", "decode_slots",
+                            f"KV cache needs {kv / 1e9:.2f} GB "
+                            f"({slots} slot(s) x {eff_seqlen} positions "
+                            f"x {n_attention} attention layer(s) x dim "
+                            f"{embed_dim}) but {chip} holds "
+                            f"{cap / 1e9:.1f} GB HBM — before weights; "
+                            "shrink decode_slots or decode_max_seqlen "
+                            "(doc/memory.md)"))
+    # --- sampling knob consistency
+    kind = last.get("serve_gen_sample", "greedy")
+    if kind == "greedy":
+        for k in ("serve_gen_temp", "serve_gen_topk"):
+            if k in last:
+                add(Finding("warn", k,
+                            f"{k} has no effect under serve_gen_sample "
+                            "= greedy (argmax ignores it)"))
+                break
+    elif kind == "temperature" and "serve_gen_topk" in last:
+        add(Finding("warn", "serve_gen_topk",
+                    "serve_gen_topk has no effect under "
+                    "serve_gen_sample = temperature; set "
+                    "serve_gen_sample = topk"))
+    elif kind == "topk" and "serve_gen_topk" not in last:
+        add(Finding("warn", "serve_gen_sample",
+                    "serve_gen_sample = topk without serve_gen_topk: "
+                    "the cutoff defaults to the full vocabulary "
+                    "(plain temperature sampling)"))
 
 
 def _mesh_rules(last: Dict[str, str], layer_types: List[str],

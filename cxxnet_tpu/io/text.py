@@ -62,6 +62,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..analysis.schema import K
+from ..monitor import log as mlog
 from .data import DataBatch, DataInst, IIterator
 
 TOK_MAGIC = b"CXTPUTOK"

@@ -174,6 +174,41 @@ class LabelInfo:
 
 
 @dataclasses.dataclass
+class DecodeState:
+    """KV-cache plumbing for incremental decode (serve/decode.py).
+
+    Threaded through :class:`ForwardContext` so cache-aware layers
+    (embedding's position offset, attention's cache append + length-
+    masked read) can see it without changing the ``forward`` signature.
+    Two modes:
+
+    * ``"prefill"`` — the forward runs over a whole prompt at its
+      natural shape; attention layers CAPTURE their freshly computed
+      (k, v) into ``caches[key]`` and otherwise compute the normal
+      causal path, so prefill logits are byte-identical to a plain
+      eval forward.
+    * ``"step"`` — the forward runs one position (seq len 1) per row;
+      attention layers SCATTER the new (k, v) into ``caches[key]`` at
+      ``positions`` and attend over the whole cache under the mask
+      ``arange(max_seqlen) <= positions``, which zeroes every not-yet-
+      written slot exactly (softmax of ``NEG_INF`` underflows to 0.0),
+      making the reduction bitwise equal to the full-forward one at f32.
+
+    ``caches`` maps the attention connection's decode key (stamped by
+    the engine) to ``{"k": (rows, heads, max_seqlen, head_dim),
+    "v": ...}`` arrays; layers write updated arrays back in place of
+    the old ones so the engine can return them as donated outputs.
+    """
+
+    mode: str                               # "prefill" | "step"
+    caches: Dict[str, Dict[str, jnp.ndarray]]
+    # (rows,) int32 — step mode: the position being written (= number of
+    # tokens already in the cache); prefill mode: unused (None)
+    positions: Optional[jnp.ndarray] = None
+    max_seqlen: int = 0
+
+
+@dataclasses.dataclass
 class ForwardContext:
     """Per-call context threaded through the traced forward pass."""
 
@@ -191,6 +226,9 @@ class ForwardContext:
     # device mesh for layers that shard explicitly (ring attention over a
     # "seq" axis); None for single-device runs
     mesh: Optional[Any] = None
+    # incremental-decode cache state (serve/decode.py); None outside
+    # task=serve generation
+    decode: Optional[DecodeState] = None
     _rng_count: int = 0
 
     def next_rng(self) -> jax.Array:

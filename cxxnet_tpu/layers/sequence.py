@@ -138,8 +138,19 @@ class EmbeddingLayer(Layer):
         ids = inputs[0].reshape(inputs[0].shape[0], -1).astype(jnp.int32)
         out = jnp.take(params["wmat"], ids, axis=0)  # (b, s, d)
         if "wpos" in params:
+            dec = getattr(ctx, "decode", None)
             pos = _label_field(ctx, self.pos_key)
-            if pos is not None:
+            if dec is not None and dec.mode == "step":
+                # single-token decode (serve/decode.py): every row sits
+                # at its own absolute position — gather one positional
+                # row per batch element.  Identical arithmetic to the
+                # sequential broadcast's row at that position, so the
+                # incremental forward stays bitwise equal to the full one
+                pidx = jnp.clip(dec.positions.astype(jnp.int32), 0,
+                                params["wpos"].shape[0] - 1)
+                out = out + jnp.take(params["wpos"], pidx,
+                                     axis=0)[:, None, :].astype(out.dtype)
+            elif pos is not None:
                 # packed documents: positions reset at each doc start —
                 # gather per (b, s) position ids instead of broadcasting
                 # the sequential table (eval forwards carry no label
@@ -332,6 +343,15 @@ class AttentionLayer(Layer):
             qkv = qkv + params["bqkv"].astype(x.dtype)
         qkv = qkv.reshape(b, s, 3, h, hd).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # (b, h, s, hd)
+        dec = getattr(ctx, "decode", None)
+        if dec is not None:
+            att = self._decode_attention(dec, q, k, v)
+            att = att.transpose(0, 2, 1, 3).reshape(b, 1, s, d)
+            out = jnp.einsum("bcsd,nd->bcsn", att,
+                             params["wout"].astype(x.dtype))
+            if "bout" in params:
+                out = out + params["bout"].astype(x.dtype)
+            return [out], buffers
         seg = _label_field(ctx, self.segment_key)
         if seg is not None:
             seg = seg.astype(jnp.int32)  # (b, s) doc segments; 0 = pad
@@ -353,6 +373,47 @@ class AttentionLayer(Layer):
         if "bout" in params:
             out = out + params["bout"].astype(x.dtype)
         return [seq_constraint(out, ctx)], buffers
+
+    def _decode_attention(self, dec, q, k, v):
+        """Cache-aware attention for incremental decode (serve/decode.py).
+
+        Prefill captures this layer's fresh (k, v) into the decode cache
+        and otherwise runs the stock causal path, so prefill logits are
+        byte-identical to a plain eval forward.  Step mode (seq len 1)
+        scatters the new position's (k, v) into the cache and attends
+        over the whole ``max_seqlen`` cache under the length mask
+        ``arange(S) <= position``: masked scores get ``ring.NEG_INF``
+        exactly like the causal mask in :func:`ring._block_scores`,
+        softmax to exactly 0.0, and contribute nothing to the p·V
+        reduction — which is how the incremental logits stay bitwise
+        equal to the full forward at f32 even though never-written cache
+        slots hold stale (finite) garbage.
+        """
+        key = getattr(self, "_decode_key", None)
+        assert key is not None, \
+            "attention: decode forward without an engine-stamped cache key"
+        assert self.causal, "incremental decode requires causal = 1"
+        if dec.mode != "step":
+            dec.caches[key] = {"k": k, "v": v}
+            return _single_device_attention(q, k, v, True, seg=None)
+        b, h, s, hd = q.shape
+        assert s == 1, f"decode step expects seq len 1, got {s}"
+        cache = dec.caches[key]
+        rows = jnp.arange(b)
+        # advanced indices at dims 0 and 2 with a slice between: the
+        # broadcast (b,) x (b,) pair leads the result, giving (b, h, hd)
+        # update slots — exactly k[:, :, 0, :]'s shape
+        ck = cache["k"].at[rows, :, dec.positions].set(k[:, :, 0, :])
+        cv = cache["v"].at[rows, :, dec.positions].set(v[:, :, 0, :])
+        dec.caches[key] = {"k": ck, "v": cv}
+        scale = 1.0 / (hd ** 0.5)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
+                            preferred_element_type=jnp.float32) * scale
+        mask = jnp.arange(ck.shape[2])[None, :] <= dec.positions[:, None]
+        scores = jnp.where(mask[:, None, None, :], scores, ring.NEG_INF)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          cv.astype(p.dtype)).astype(q.dtype)
 
 
 class SoftmaxSeqLayer(LossLayerBase):

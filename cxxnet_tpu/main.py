@@ -1365,6 +1365,149 @@ class LearnTask:
             self._close_prefetchers()
         mlog.notice(f"finished extraction, write into {self.name_pred}")
 
+    def task_serve_gen(self, cfg) -> None:
+        """``task = serve`` + ``serve_gen = 1``: autoregressive
+        generation through the KV-cache incremental-decode engine with
+        token-level continuous batching (serve/decode.py, doc/serve.md
+        "Incremental decode").  Each valid pred-iterator row's leading
+        ``serve_gen_prompt`` token ids become one generation request;
+        ``serve_clients`` threads submit them concurrently and the step
+        scheduler keeps the ``decode_slots`` batch full.  Generated ids
+        land in ``name_pred`` (space-separated per request); the run
+        emits per-token + per-request ``latency`` records and one
+        ``serve_gen`` record (tokens/sec, occupancy histogram, retrace
+        count — the telemetry ``bench.py --lm-serve`` sweeps)."""
+        from .serve.host import GenModel
+        metrics = self.net.metrics
+        gm = GenModel(self.net, cfg, metrics=metrics)
+        mlog.notice(
+            f"serve: warming decode engine ({cfg.slots} slot(s), "
+            f"max_seqlen {gm.engine.max_seqlen}, 2 executables) ...")
+        gm.warmup()
+        mlog.info(f"serve: decode warmup compiled in "
+                  f"{gm.engine.warmup_sec:.1f} sec")
+        footprint = gm.footprint()
+        if footprint:
+            metrics.set_gauge("serve_footprint_bytes",
+                              footprint["total_bytes"])
+            mlog.info(
+                f"serve: decode footprint "
+                f"{footprint['total_bytes'] / 1e6:.1f} MB/device "
+                f"(KV cache {footprint['kv_cache_bytes'] / 1e6:.2f} MB "
+                f"over {cfg.slots} slot(s))")
+        import queue as _queue
+        import threading
+        results: dict = {}
+        errors: List[BaseException] = []
+        abort = threading.Event()
+        work: "_queue.Queue" = _queue.Queue(maxsize=cfg.queue_depth)
+        _DONE = object()
+        n_total = [0]
+
+        def _put(item) -> bool:
+            while not abort.is_set():
+                try:
+                    work.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                self.itr_pred.before_first()
+                idx = 0
+                while True:
+                    batch = self.itr_pred.next()
+                    if batch is None:
+                        break
+                    valid = np.array(
+                        batch.data[:batch.batch_size
+                                   - batch.num_batch_padd], np.float32)
+                    rows = valid.reshape(valid.shape[0], -1)
+                    for i in range(rows.shape[0]):
+                        prompt = rows[i, :cfg.gen_prompt].astype(np.int32)
+                        if not _put((idx, prompt)):
+                            return
+                        idx += 1
+                n_total[0] = idx
+            except BaseException as e:  # noqa: BLE001 — reported below
+                errors.append(e)
+                abort.set()
+            finally:
+                for _ in range(cfg.clients):
+                    if not _put(_DONE):
+                        return
+
+        def client():
+            while True:
+                try:
+                    item = work.get(timeout=0.05)
+                except _queue.Empty:
+                    if abort.is_set():
+                        return
+                    continue
+                if item is _DONE:
+                    return
+                i, prompt = item
+                try:
+                    results[i] = gm.generate(prompt)
+                except BaseException as e:  # noqa: BLE001 — reported
+                    errors.append(e)
+                    abort.set()
+                    return
+
+        mlog.notice(f"serve: streaming generation over {cfg.clients} "
+                    "client thread(s)")
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, daemon=True,
+                                    name=f"cxxnet-serve-gen-{j}")
+                   for j in range(cfg.clients)]
+        prod = threading.Thread(target=producer, daemon=True,
+                                name="cxxnet-serve-gen-producer")
+        try:
+            prod.start()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            prod.join()
+            dur = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            # disclint: ok(atomic-write) — streamed product rows
+            with open(self.name_pred, "w") as fo:
+                for i in range(n_total[0]):
+                    fo.write(" ".join(str(t) for t in results[i]) + "\n")
+            self._emit_latency_record("token")
+            self._emit_latency_record("gen")
+            metrics.set_gauge("serve_retraces", gm.retraces)
+            stats = gm.scheduler.stats()
+            tps = stats["tokens"] / max(dur, 1e-9)
+            if metrics.active:
+                metrics.emit(
+                    "serve_gen", model=gm.name,
+                    duration_sec=round(dur, 3),
+                    tokens_per_sec=round(tps, 1),
+                    slots=cfg.slots, max_seqlen=gm.engine.max_seqlen,
+                    gen_tokens=cfg.gen_tokens, clients=cfg.clients,
+                    sample=cfg.gen_sample, retraces=gm.retraces,
+                    **stats,
+                    **({"footprint": footprint} if footprint else {}))
+            if gm.retraces:
+                mlog.warn(f"serve: {gm.retraces} decode retrace(s) past "
+                          "warmup — a shape escaped the two pinned "
+                          "executables (engine bug)")
+            mlog.result(
+                f"serve: generated {stats['tokens']} tokens for "
+                f"{n_total[0]} requests in {dur:.2f} sec "
+                f"({tps:.1f} tok/s, mean occupancy "
+                f"{stats['mean_occupancy']}, "
+                f"{stats['batching']} batching), retraces {gm.retraces}")
+        finally:
+            gm.close()
+        mlog.notice(f"finished serving, wrote {self.name_pred}")
+
     def task_serve(self) -> None:
         """``task = serve``: host the loaded model behind the dynamic
         micro-batching predict engine and replay the ``pred`` iterator
@@ -1374,13 +1517,17 @@ class LearnTask:
         ``name_pred`` exactly like ``task = pred``; the run emits the
         serving telemetry the observatory reads (one ``latency`` record
         with p50/p95/p99, a ``serve`` record with QPS / batch-size
-        histogram / queue-depth stats, and the retrace gauge)."""
+        histogram / queue-depth stats, and the retrace gauge).
+        ``serve_gen = 1`` routes to :meth:`task_serve_gen` — KV-cache
+        incremental decode for LM netconfigs."""
         assert self.itr_pred is not None, (
             "task=serve requires a 'pred = <out>' iterator section "
             "(the request stream)")
         from .serve import ServeConfig
         from .serve.host import ServeModel
         cfg = ServeConfig.from_pairs(self.cfg)
+        if cfg.gen:
+            return self.task_serve_gen(cfg)
         metrics = self.net.metrics
         sm = ServeModel(self.net, cfg, metrics=metrics)
         mlog.notice(

@@ -131,12 +131,16 @@ class Network:
 
     # -- forward ------------------------------------------------------------
     def forward(self, params: Params, buffers: Params,
-                inputs: Dict[int, jnp.ndarray], ctx: ForwardContext
+                inputs: Dict[int, jnp.ndarray], ctx: ForwardContext,
+                until: Optional[int] = None
                 ) -> Tuple[List[Optional[jnp.ndarray]], Params]:
         """Run all connections in declaration order.
 
         Returns (node value list indexed by node id, updated buffers).
         Node values are SSA: self-loop layers rebind their node's entry.
+        ``until`` stops BEFORE connection index ``until`` — the decode
+        engine uses it to read raw LM-head logits without running the
+        softmax_seq self-loop that would rebind the logits node.
         """
         from .. import engine
         from ..layers.base import conn_scope_name, materialize
@@ -148,6 +152,8 @@ class Network:
         fuse_skip = getattr(self, "fuse_skip", frozenset())
         virtual = engine.opts.concat_virtual == "1"
         for i, conn in enumerate(self.connections):
+            if until is not None and i >= until:
+                break
             if i in fuse_skip:
                 continue
             # layer-attribution stamp: HLO op metadata (and so the

@@ -89,6 +89,39 @@ SERVE_KEYS = (
     K("serve_sentinel_window", "float", lo=0.01,
       help="seconds per sentinel observation window (the reporter "
            "thread's cadence)"),
+    # -- incremental decode / generation (serve/decode.py, doc/serve.md)
+    K("serve_gen", "int", lo=0, hi=1,
+      help="task=serve: autoregressive generation through the KV-cache "
+           "decode engine instead of batch predict (LM netconfigs)"),
+    K("decode_slots", "int", lo=1,
+      help="in-flight decode batch: cache rows the step executable "
+           "carries (token-level continuous batching keeps them full)"),
+    K("decode_max_seqlen", "int", lo=1,
+      help="KV-cache length per slot; must equal the netconfig input "
+           "width (the prefill executable runs the net at its declared "
+           "width).  Unset = the input width"),
+    K("serve_gen_tokens", "int", lo=1,
+      help="max new tokens generated per request"),
+    K("serve_gen_sample", "enum",
+      choices=("greedy", "temperature", "topk"),
+      help="sampling off the LM head: greedy argmax (deterministic), "
+           "temperature softmax, or top-k restricted"),
+    K("serve_gen_temp", "float", lo=1e-6,
+      help="softmax temperature for temperature/topk sampling"),
+    K("serve_gen_topk", "int", lo=1,
+      help="top-k cutoff for serve_gen_sample = topk"),
+    K("serve_gen_seed", "int", lo=0,
+      help="per-request deterministic sampling seed"),
+    K("serve_gen_eos", "int", lo=-1,
+      help="stop token id (-1 = never; generation runs to "
+           "serve_gen_tokens or the cache end)"),
+    K("serve_gen_prompt", "int", lo=1,
+      help="task=serve: prompt length taken from each pred-iterator "
+           "row's leading token ids"),
+    K("serve_gen_batching", "enum", choices=("continuous", "request"),
+      help="continuous = requests join/leave the decode batch between "
+           "steps; request = fill a batch and run it to completion "
+           "(the A/B baseline)"),
 )
 
 
@@ -106,6 +139,18 @@ class ServeConfig:
     queue_depth: int = 64
     sentinel: int = 0
     sentinel_window: float = 1.0
+    # incremental decode / generation (serve/decode.py)
+    gen: int = 0
+    slots: int = 4
+    max_seqlen: int = 0         # 0 = the netconfig input width
+    gen_tokens: int = 32
+    gen_sample: str = "greedy"
+    gen_temp: float = 1.0
+    gen_topk: int = 0
+    gen_seed: int = 0
+    gen_eos: int = -1
+    gen_prompt: int = 8
+    gen_batching: str = "continuous"
 
     def __post_init__(self):
         if self.sentinel_window <= 0:
@@ -124,12 +169,24 @@ class ServeConfig:
                 "int8")
         if self.max_batch <= 0:
             self.max_batch = max(self.shapes)
+        if self.gen_sample not in ("greedy", "temperature", "topk"):
+            raise ValueError(
+                f"serve_gen_sample = {self.gen_sample!r}: expected "
+                "greedy, temperature, or topk")
+        if self.gen_batching not in ("continuous", "request"):
+            raise ValueError(
+                f"serve_gen_batching = {self.gen_batching!r}: expected "
+                "continuous or request")
+        if self.gen_sample == "topk" and self.gen_topk < 1:
+            raise ValueError(
+                "serve_gen_sample = topk requires serve_gen_topk >= 1")
 
     @classmethod
     def from_pairs(cls, pairs: Sequence[Tuple[str, str]]) -> "ServeConfig":
         """Build from ordered config pairs (last occurrence wins, like
         every ``set_param`` consumer)."""
-        last = {k: v for k, v in pairs if k.startswith("serve_")}
+        last = {k: v for k, v in pairs
+                if k.startswith("serve_") or k.startswith("decode_")}
         kw = {}
         if "serve_shapes" in last:
             kw["shapes"] = tuple(parse_shapes(last["serve_shapes"]))
@@ -141,7 +198,19 @@ class ServeConfig:
                                  ("serve_queue_depth", "queue_depth", int),
                                  ("serve_sentinel", "sentinel", int),
                                  ("serve_sentinel_window",
-                                  "sentinel_window", float)):
+                                  "sentinel_window", float),
+                                 ("serve_gen", "gen", int),
+                                 ("decode_slots", "slots", int),
+                                 ("decode_max_seqlen", "max_seqlen", int),
+                                 ("serve_gen_tokens", "gen_tokens", int),
+                                 ("serve_gen_sample", "gen_sample", str),
+                                 ("serve_gen_temp", "gen_temp", float),
+                                 ("serve_gen_topk", "gen_topk", int),
+                                 ("serve_gen_seed", "gen_seed", int),
+                                 ("serve_gen_eos", "gen_eos", int),
+                                 ("serve_gen_prompt", "gen_prompt", int),
+                                 ("serve_gen_batching",
+                                  "gen_batching", str)):
             if key in last:
                 kw[field] = conv(last[key])
         return cls(**kw)

@@ -355,3 +355,336 @@ class MicroBatcher:
             "queue_depth_mean": round(self.mean_depth, 2),
             "queue_depth_max": self.depth_max,
         }
+
+
+@dataclasses.dataclass
+class _GenRequest:
+    """One generation request riding the step scheduler."""
+    prompt: "np.ndarray"
+    max_new: int
+    event: threading.Event
+    t0: float
+    rng: Optional[Any] = None
+    tokens: Optional[list] = None       # generated ids (the result)
+    pos: int = 0                        # next cache write position
+    error: Optional[BaseException] = None
+    trace_id: Optional[int] = None
+    tid: Optional[str] = None
+
+
+class StepScheduler:
+    """Token-level continuous batching over a decode ``runner``
+    (:class:`~cxxnet_tpu.serve.decode.DecodeEngine` or a fake with the
+    same ``slots`` / ``prefill(slot, tokens)`` / ``step(tokens,
+    positions)`` surface).
+
+    The MicroBatcher generalized from request-level to STEP-level
+    scheduling: instead of coalescing whole requests into one dispatch,
+    the dispatcher thread runs a decode loop where requests join and
+    leave the in-flight batch BETWEEN single-token steps — a finished
+    sequence's cache slot is freed and immediately refilled from the
+    queue, so a short generation never waits on the longest one in its
+    batch (no head-of-line blocking).  ``continuous=False`` degrades to
+    request-level batching (admit only into an EMPTY batch, run it to
+    completion) — the A/B baseline ``bench.py --lm-serve`` measures
+    against.
+
+    Thread discipline is MicroBatcher's verbatim: bounded queue,
+    ``None`` shutdown sentinel, a runner exception latches the
+    scheduler dead and fans out to every active AND queued request —
+    clients get the exception, never a hang."""
+
+    def __init__(self, runner, *, max_new_tokens: int = 32,
+                 eos: int = -1, sample: str = "greedy",
+                 temp: float = 1.0, topk: int = 0, seed: int = 0,
+                 queue_depth: int = 64, continuous: bool = True,
+                 metrics=None, name: str = "decode"):
+        self.runner = runner
+        self.max_new_tokens = max(1, int(max_new_tokens))
+        self.eos = int(eos)
+        self.sample_kind = sample
+        self.temp = float(temp)
+        self.topk = int(topk)
+        self.seed = int(seed)
+        self.continuous = bool(continuous)
+        self.metrics = metrics
+        self.name = name
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_depth)))
+        self._thread: Optional[threading.Thread] = None
+        self._failed: Optional[BaseException] = None
+        self._closing = False
+        self._draining = False
+        self._active: Dict[int, _GenRequest] = {}
+        self._free: list = list(range(runner.slots))
+        self._req_seq = 0
+        # accounting for the serve_gen record / --lm-serve sweep
+        self.n_requests = 0
+        self.n_tokens = 0
+        self.n_steps = 0
+        self.n_prefills = 0
+        self.occ_hist: Dict[int, int] = {}
+        self._tok_lats: list = []       # per-step decode+sample wall
+        self._stats_lock = threading.Lock()
+
+    # ------------------------------------------------------------- client
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"cxxnet-decode-sched-{self.name}")
+        self._thread.start()
+
+    def submit(self, prompt: "np.ndarray",
+               max_new_tokens: Optional[int] = None) -> list:
+        """One generation request: blocks until the sequence finishes
+        (or the scheduler dies) and returns the generated token ids.
+        Thread-safe; prompts longer than the cache are rejected here,
+        not in the decode loop."""
+        if self._failed is not None:
+            raise self._failed
+        if self._closing:
+            raise ServeClosed(f"scheduler {self.name!r} is shut down")
+        assert self._thread is not None, "call start() first"
+        prompt = np.asarray(prompt).reshape(-1)
+        limit = getattr(self.runner, "max_seqlen", None)
+        if prompt.shape[0] < 1 or (limit is not None
+                                   and prompt.shape[0] > limit):
+            raise ValueError(
+                f"submit: prompt of {prompt.shape[0]} tokens, cache "
+                f"holds 1..{limit}")
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        with self._stats_lock:
+            self._req_seq += 1
+            rid = self._req_seq
+        rng = np.random.RandomState((self.seed * 1000003 + rid)
+                                    % (2 ** 31)) \
+            if self.sample_kind != "greedy" else None
+        req = _GenRequest(prompt=prompt,
+                          max_new=int(max_new_tokens
+                                      or self.max_new_tokens),
+                          event=threading.Event(),
+                          t0=time.perf_counter(), rng=rng)
+        if tracer is not None and tracer.enabled:
+            req.trace_id = tracer.new_trace()
+            if req.trace_id is not None:
+                req.tid = threading.current_thread().name
+        while True:
+            if self._failed is not None:
+                raise self._failed
+            if self._closing:
+                raise ServeClosed(f"scheduler {self.name!r} is shut down")
+            try:
+                self._q.put(req, timeout=0.05)
+                break
+            except queue.Full:
+                continue
+        while not req.event.wait(0.1):
+            t = self._thread
+            if t is None or not t.is_alive():
+                self._gen_drain(self._failed)
+        if req.error is not None:
+            raise req.error
+        latency = time.perf_counter() - req.t0
+        if req.trace_id is not None and tracer is not None:
+            tracer.emit("request", req.t0, req.t0 + latency,
+                        trace_id=req.trace_id, tid=req.tid,
+                        model=self.name, tokens=len(req.tokens))
+        if self.metrics is not None:
+            self.metrics.observe("gen_latency_sec", latency)
+        return req.tokens
+
+    # --------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        batch_open = True   # request-level mode: admission window —
+        while True:         # open while the batch has not stepped yet
+            if not self._active:
+                if self._draining:
+                    return
+                batch_open = True
+                r = self._q.get()
+                if r is None:
+                    self._gen_drain(None)
+                    return
+                if not self._admit(r):
+                    return
+            # token-level admission: refill free slots from the queue
+            # between steps (continuous), or fill the open batch once
+            # and run it to completion (request-level baseline — the
+            # head-of-line blocking --lm-serve measures against)
+            while self._free and not self._draining \
+                    and (self.continuous or batch_open):
+                try:
+                    r = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if r is None:
+                    self._draining = True
+                    break
+                if not self._admit(r):
+                    return
+            if not self._active:
+                continue
+            batch_open = False
+            if not self._step_once():
+                return
+
+    def _sample(self, logits, req: _GenRequest) -> int:
+        from .decode import sample_token
+        return sample_token(logits, self.sample_kind, self.temp,
+                            self.topk, req.rng)
+
+    def _finish(self, slot: int, req: _GenRequest) -> None:
+        self._free.append(slot)
+        del self._active[slot]
+        self.n_requests += 1
+        req.event.set()
+
+    def _admit(self, req: _GenRequest) -> bool:
+        """Prefill ``req`` into a free slot; False latches the
+        scheduler dead (exception already fanned out)."""
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        slot = self._free.pop()
+        try:
+            t0 = time.perf_counter()
+            logits = self.runner.prefill(slot, req.prompt)
+            t1 = time.perf_counter()
+            if req.trace_id is not None and tracer is not None:
+                tracer.emit("prefill", t0, t1, trace_id=req.trace_id,
+                            slot=slot, prompt=int(req.prompt.shape[0]),
+                            model=self.name)
+            self.n_prefills += 1
+            tok = self._sample(logits, req)
+            req.tokens = [tok]
+            req.pos = int(req.prompt.shape[0])
+            self.n_tokens += 1
+            limit = getattr(self.runner, "max_seqlen", None)
+            if tok == self.eos or len(req.tokens) >= req.max_new \
+                    or (limit is not None and req.pos >= limit):
+                self._free.append(slot)
+                self.n_requests += 1
+                req.event.set()
+            else:
+                self._active[slot] = req
+            return True
+        except BaseException as e:  # noqa: BLE001 — must reach clients
+            self._free.append(slot)
+            self._fail(e, extra=[req])
+            return False
+
+    def _step_once(self) -> bool:
+        """One single-token decode step over every active slot; False
+        latches the scheduler dead."""
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        riders = [r.trace_id for r in self._active.values()
+                  if r.trace_id is not None] \
+            if tracer is not None and tracer.enabled else []
+        slots = self.runner.slots
+        tokens = np.zeros((slots,), np.int32)
+        positions = np.zeros((slots,), np.int32)
+        for slot, req in self._active.items():
+            tokens[slot] = req.tokens[-1]
+            positions[slot] = req.pos
+        n_active = len(self._active)
+        try:
+            t0 = time.perf_counter()
+            if riders:
+                with tracer.link(riders):
+                    logits = self.runner.step(tokens, positions)
+            else:
+                logits = self.runner.step(tokens, positions)
+            t1 = time.perf_counter()
+            limit = getattr(self.runner, "max_seqlen", None)
+            for slot in list(self._active):
+                req = self._active[slot]
+                tok = self._sample(logits[slot], req)
+                req.tokens.append(tok)
+                req.pos += 1
+                self.n_tokens += 1
+                if tok == self.eos or len(req.tokens) >= req.max_new \
+                        or (limit is not None and req.pos >= limit):
+                    self._finish(slot, req)
+            t2 = time.perf_counter()
+            if riders:
+                tracer.emit("decode", t0, t1, riders=riders,
+                            active=n_active, model=self.name)
+                tracer.emit("sample", t1, t2, riders=riders,
+                            active=n_active, model=self.name)
+            self.n_steps += 1
+            self.occ_hist[n_active] = self.occ_hist.get(n_active, 0) + 1
+            step_wall = t2 - t0
+            with self._stats_lock:
+                self._tok_lats.append(step_wall)
+            if self.metrics is not None:
+                self.metrics.observe("token_latency_sec", step_wall)
+            return True
+        except BaseException as e:  # noqa: BLE001 — must reach clients
+            self._fail(e)
+            return False
+
+    def _fail(self, e: BaseException, extra=()) -> None:
+        """Latch dead and fan the exception out to every active AND
+        queued request (the MicroBatcher _run contract)."""
+        self._failed = e
+        for req in list(self._active.values()) + list(extra):
+            req.error = e
+            req.event.set()
+        self._active.clear()
+        self._gen_drain(e)
+
+    def _gen_drain(self, err: Optional[BaseException]) -> None:
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if r is None:
+                continue
+            r.error = err if err is not None else ServeClosed(
+                f"scheduler {self.name!r} shut down before this request "
+                "was served")
+            r.event.set()
+
+    # ------------------------------------------------------------ teardown
+    def close(self) -> None:
+        """Stop accepting requests, finish everything active/queued,
+        join the dispatcher, reject stragglers.  Idempotent."""
+        self._closing = True
+        if self._thread is None:
+            return
+        self._q.put(None)
+        self._thread.join()
+        self._thread = None
+        self._gen_drain(self._failed)
+
+    # ------------------------------------------------------------- stats
+    @property
+    def mean_occupancy(self) -> float:
+        if not self.occ_hist:
+            return 0.0
+        total = sum(self.occ_hist.values())
+        return sum(k * v for k, v in self.occ_hist.items()) / total
+
+    def stats(self) -> Dict[str, Any]:
+        """Decode accounting for the ``serve_gen`` JSONL record: step
+        and token counts, batch-occupancy histogram, and per-token
+        latency percentiles (ms)."""
+        with self._stats_lock:
+            lats = sorted(self._tok_lats)
+        out: Dict[str, Any] = {
+            "requests": self.n_requests,
+            "tokens": self.n_tokens,
+            "steps": self.n_steps,
+            "prefills": self.n_prefills,
+            "mean_occupancy": round(self.mean_occupancy, 2),
+            "occupancy_hist": {str(k): v for k, v
+                               in sorted(self.occ_hist.items())},
+            "batching": "continuous" if self.continuous else "request",
+        }
+        if lats:
+            from ..monitor.metrics import nearest_rank
+            out.update(
+                tok_p50_ms=round(nearest_rank(lats, 50) * 1e3, 3),
+                tok_p95_ms=round(nearest_rank(lats, 95) * 1e3, 3),
+                tok_p99_ms=round(nearest_rank(lats, 99) * 1e3, 3))
+        return out

@@ -81,6 +81,61 @@ class ServeModel:
         self.batcher.close()
 
 
+class GenModel:
+    """One served LM: a KV-cache decode engine fronted by the
+    token-level continuous-batching step scheduler (serve/decode.py,
+    doc/serve.md "Incremental decode").  The generation-side sibling of
+    :class:`ServeModel` — same warmup / retraces / footprint / close
+    surface, ``generate`` instead of ``predict``."""
+
+    def __init__(self, trainer, cfg: Optional[ServeConfig] = None, *,
+                 metrics=None, name: str = "default"):
+        from .batcher import StepScheduler
+        from .decode import DecodeEngine
+        self.name = name
+        self.cfg = cfg or ServeConfig(gen=1)
+        self.trainer = trainer
+        self.metrics = metrics if metrics is not None else trainer.metrics
+        self.engine = DecodeEngine(trainer, slots=self.cfg.slots,
+                                   max_seqlen=self.cfg.max_seqlen,
+                                   metrics=self.metrics)
+        self.scheduler = StepScheduler(
+            self.engine, max_new_tokens=self.cfg.gen_tokens,
+            eos=self.cfg.gen_eos, sample=self.cfg.gen_sample,
+            temp=self.cfg.gen_temp, topk=self.cfg.gen_topk,
+            seed=self.cfg.gen_seed, queue_depth=self.cfg.queue_depth,
+            continuous=self.cfg.gen_batching == "continuous",
+            metrics=self.metrics, name=name)
+
+    def warmup(self) -> None:
+        """Compile both decode executables and start the scheduler;
+        after this, generation never traces (``retraces`` stays 0)."""
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        if tracer is not None and tracer.enabled:
+            with tracer.span("decode_warmup", model=self.name,
+                             slots=self.engine.slots):
+                self.engine.warmup()
+        else:
+            self.engine.warmup()
+        self.scheduler.start()
+
+    def generate(self, prompt: np.ndarray,
+                 max_new_tokens: Optional[int] = None) -> list:
+        """Generated token ids for ``prompt``, decoded alongside
+        whatever other sequences are in flight.  Thread-safe."""
+        return self.scheduler.submit(prompt, max_new_tokens)
+
+    @property
+    def retraces(self) -> int:
+        return self.engine.retraces
+
+    def footprint(self) -> Dict[str, int]:
+        return self.engine.footprint()
+
+    def close(self) -> None:
+        self.scheduler.close()
+
+
 class ModelHost:
     """Concurrent multi-model routing over the shared device pool."""
 

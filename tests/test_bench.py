@@ -195,3 +195,34 @@ def test_bench_dp_scaling_mode():
     # engine options restored (process-global hygiene)
     from cxxnet_tpu.engine import opts
     assert opts.dp_overlap == "0"
+
+
+def test_bench_lm_serve_mode():
+    """--lm-serve --tiny payload: aggregate tokens/sec + per-token
+    percentiles + occupancy histogram per offered-load point, the
+    continuous-vs-request A/B with its speedup field, and the
+    zero-retrace contract across the whole sweep."""
+    import bench
+    payload = bench.bench_lm_serve(["--tiny", "dev=cpu"])
+    assert payload["metric"] == "lm_serve_tokens_per_sec"
+    assert payload["value"] > 0
+    assert payload["retraces"] == 0
+    assert payload["kv_cache_bytes"] > 0
+    assert payload["warmup_sec"] > 0
+    [pt] = payload["points"]
+    assert pt["clients"] == 2
+    assert pt["tokens_per_sec"] > 0
+    assert pt["requests"] == 6 and pt["tokens"] > 0
+    assert 0 < pt["tok_p50_ms"] <= pt["tok_p95_ms"] <= pt["tok_p99_ms"]
+    assert sum(pt["occupancy_hist"].values()) == pt["steps"]
+    assert pt["batching"] == "continuous"
+    ab = payload["ab"]
+    assert ab["continuous"]["batching"] == "continuous"
+    assert ab["request"]["batching"] == "request"
+    # same work either way; only the admission policy differs
+    assert ab["continuous"]["tokens"] == ab["request"]["tokens"]
+    assert payload["speedup_continuous"] > 0
+    # thread hygiene: every scheduler closed
+    import threading
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxxnet-decode")]

@@ -1,0 +1,451 @@
+"""Incremental decode (serve/decode.py + StepScheduler — ISSUE 16).
+
+Covers the contracts KV-cached generation stands on: prefill and
+single-token step logits are BITWISE equal to the O(N²) full forward at
+f32 (the property that makes the cache safe to enable); the two AOT
+executables never retrace after warmup, asserted through the real
+task=serve CLI; the step scheduler admits requests into the in-flight
+batch BETWEEN decode steps (continuous batching) and degrades to
+request-level batching under ``continuous=False``; a runner exception
+latches the scheduler dead and reaches every client (no hangs); and
+sampling off the LM head is deterministic per request seed.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.serve.batcher import ServeClosed, StepScheduler
+from cxxnet_tpu.serve.decode import DecodeEngine, sample_token
+
+
+# ------------------------------------------------------------ engine parity
+
+@pytest.fixture(scope="module")
+def lm_trainer():
+    from cxxnet_tpu.models import transformer
+    from __graft_entry__ import _make_trainer
+    return _make_trainer(
+        transformer(vocab=64, seq=32, dim=32, nlayer=2, nhead=2),
+        2, "cpu", extra=[("updater", "sgd"), ("eta", "0.01"),
+                         ("eval_train", "0"), ("silent", "1")])
+
+
+@pytest.fixture(scope="module")
+def engine(lm_trainer):
+    eng = DecodeEngine(lm_trainer, slots=2, max_seqlen=32)
+    eng.warmup()
+    return eng
+
+
+def _prompt(n, seed=0, vocab=64):
+    return np.random.RandomState(seed).randint(0, vocab, n) \
+        .astype(np.int32)
+
+
+def test_prefill_matches_full_forward_bitwise(engine):
+    """Prefill logits at the last prompt position are byte-identical to
+    the cache-free eval forward: capture is a tee, not a rewrite."""
+    for L in (1, 5, 17, 32):
+        p = _prompt(L, seed=L)
+        inc = engine.prefill(0, p)
+        full = engine.full_logits(p)
+        assert inc.dtype == np.float32
+        assert np.array_equal(inc, full[L - 1]), f"prompt len {L}"
+
+
+def test_incremental_steps_match_full_forward_bitwise(engine):
+    """Greedy decode through the cache: every step's logits row equals
+    the full forward over the grown sequence, bitwise at f32 — masked
+    cache positions softmax to exactly 0.0 and drop out of the p·V
+    reduction, so stale garbage in unwritten slots is invisible."""
+    p = list(_prompt(6, seed=42))
+    logits = engine.prefill(1, np.asarray(p, np.int32))
+    seq = list(p) + [int(np.argmax(logits))]
+    for _ in range(8):
+        pos = len(seq) - 1
+        step = engine.step(np.asarray([0, seq[-1]], np.int32),
+                           np.asarray([0, pos], np.int32))
+        full = engine.full_logits(np.asarray(seq, np.int32))
+        assert np.array_equal(step[1], full[pos])
+        seq.append(int(np.argmax(step[1])))
+    assert engine.retraces == 0
+
+
+def test_engine_zero_retrace_and_footprint(engine):
+    """Mixed prefill/step traffic after warmup: zero retraces, and the
+    footprint's kv_cache_bytes matches the analytic sizing the lint
+    rule uses (2 · layers · slots · nhead · seqlen · head_dim · 4)."""
+    for L in (3, 9, 30):
+        engine.prefill(L % 2, _prompt(L, seed=L))
+        engine.step(np.zeros(2, np.int32),
+                    np.asarray([L, 0], np.int32))
+    assert engine.retraces == 0
+    fp = engine.footprint()
+    if fp:  # backend memory_analysis is optional
+        assert fp["kv_cache_bytes"] == engine.kv_cache_bytes()
+        assert fp["buckets"] == 2
+        assert fp["total_bytes"] >= fp["weight_bytes"]
+    assert engine.kv_cache_bytes() \
+        == 2 * 2 * 2 * engine.nhead * 32 * engine.head_dim * 4
+
+
+def test_engine_validation(engine, lm_trainer):
+    with pytest.raises(ValueError, match="decode_max_seqlen"):
+        DecodeEngine(lm_trainer, slots=2, max_seqlen=64)
+    with pytest.raises(ValueError, match="prompt of 33"):
+        engine.prefill(0, _prompt(33))
+    with pytest.raises(ValueError, match="slot 7"):
+        engine.prefill(7, _prompt(4))
+
+
+def test_engine_rejects_bidirectional_attention():
+    from cxxnet_tpu.models import transformer
+    from __graft_entry__ import _make_trainer
+    t = _make_trainer(
+        transformer(vocab=16, seq=8, dim=8, nlayer=1, nhead=1, causal=0),
+        1, "cpu", extra=[("updater", "sgd"), ("eta", "0.01"),
+                         ("eval_train", "0"), ("silent", "1")])
+    with pytest.raises(ValueError, match="causal"):
+        DecodeEngine(t, slots=1)
+
+
+# ---------------------------------------------------------------- sampling
+
+def test_sample_token_modes():
+    logits = np.array([0.1, 3.0, -1.0, 2.9], np.float32)
+    assert sample_token(logits, "greedy") == 1
+    # topk=1 degenerates to argmax no matter the rng draw
+    rng = np.random.RandomState(0)
+    assert sample_token(logits, "topk", topk=1, rng=rng) == 1
+    # topk support restriction: ids outside the top-2 never sampled
+    rng = np.random.RandomState(1)
+    draws = {sample_token(logits, "topk", temp=2.0, topk=2, rng=rng)
+             for _ in range(64)}
+    assert draws <= {1, 3}
+    # temperature sampling is deterministic per rng state
+    a = sample_token(logits, "temperature", temp=1.5,
+                     rng=np.random.RandomState(7))
+    b = sample_token(logits, "temperature", temp=1.5,
+                     rng=np.random.RandomState(7))
+    assert a == b
+    with pytest.raises(ValueError, match="serve_gen_sample"):
+        sample_token(logits, "nucleus")
+
+
+# ------------------------------------------------- scheduler (fake runner)
+# A fake runner keeps these pure thread-protocol tests: no jax, no model.
+# Logits are rigged so greedy always emits token (slot + 1) — never the
+# eos (0), so generation length is controlled by max_new_tokens alone.
+
+class FakeRunner:
+    def __init__(self, slots=2, max_seqlen=64, step_sleep=0.004,
+                 fail_after=None):
+        self.slots = slots
+        self.max_seqlen = max_seqlen
+        self.step_sleep = step_sleep
+        self.fail_after = fail_after
+        self.prefill_log = []            # (slot, prompt_len)
+        self.step_actives = []           # tuple of active slots per step
+        self.lock = threading.Lock()
+
+    def _logits(self, slot):
+        row = np.zeros(8, np.float32)
+        row[slot + 1] = 1.0
+        return row
+
+    def prefill(self, slot, tokens):
+        with self.lock:
+            self.prefill_log.append((slot, len(tokens)))
+        return self._logits(slot)
+
+    def step(self, tokens, positions):
+        with self.lock:
+            self.step_actives.append(
+                tuple(int(i) for i in np.nonzero(positions)[0]))
+            if self.fail_after is not None \
+                    and len(self.step_actives) > self.fail_after:
+                raise RuntimeError("device fell over")
+        time.sleep(self.step_sleep)
+        return np.stack([self._logits(s) for s in range(self.slots)])
+
+
+def _submit_async(sched, prompt, max_new):
+    out = {}
+
+    def run():
+        try:
+            out["tokens"] = sched.submit(prompt, max_new)
+        except BaseException as e:  # noqa: BLE001 — asserted by tests
+            out["error"] = e
+        out["done_at"] = time.perf_counter()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th, out
+
+
+def _wait(pred, timeout=5.0):
+    t0 = time.perf_counter()
+    while not pred():
+        assert time.perf_counter() - t0 < timeout, "test timed out"
+        time.sleep(0.002)
+
+
+def test_scheduler_joins_and_leaves_between_steps():
+    """Continuous batching: a request submitted mid-flight joins the
+    active batch between steps, a short one finishes and frees its slot
+    while the long one keeps decoding, and the freed slot is REUSED by
+    the next admission — no head-of-line blocking."""
+    fr = FakeRunner(slots=2)
+    s = StepScheduler(fr, max_new_tokens=40, eos=0, queue_depth=8)
+    s.start()
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        ta, a = _submit_async(s, prompt, 40)
+        _wait(lambda: len(fr.step_actives) >= 2)
+        tb, b = _submit_async(s, prompt, 3)
+        tb.join(5.0)
+        assert b["tokens"] is not None and len(b["tokens"]) == 3
+        assert "error" not in b
+        assert ta.is_alive()  # B finished while A still decodes
+        # B rode the same batch as A for at least one step
+        assert any(len(act) == 2 for act in fr.step_actives)
+        slot_b = fr.prefill_log[1][0]
+        # the freed slot is immediately reusable: C lands on B's slot
+        tc, c = _submit_async(s, prompt, 2)
+        tc.join(5.0)
+        assert len(c["tokens"]) == 2
+        assert fr.prefill_log[2][0] == slot_b
+        ta.join(10.0)
+        assert len(a["tokens"]) == 40
+    finally:
+        s.close()
+    st = s.stats()
+    assert st["requests"] == 3 and st["prefills"] == 3
+    assert st["tokens"] == 45
+    assert st["batching"] == "continuous"
+    # every step is histogrammed; tokens = prefill samples + step samples
+    assert sum(st["occupancy_hist"].values()) == st["steps"]
+    assert sum(int(k) * v for k, v in st["occupancy_hist"].items()) \
+        == st["tokens"] - st["prefills"]
+    assert st["tok_p50_ms"] <= st["tok_p95_ms"] <= st["tok_p99_ms"]
+
+
+def test_scheduler_request_mode_runs_batch_to_completion():
+    """continuous=False is the A/B baseline: a request submitted after
+    the batch started stepping waits for the WHOLE batch to drain —
+    the head-of-line blocking --lm-serve measures against."""
+    fr = FakeRunner(slots=2)
+    s = StepScheduler(fr, max_new_tokens=40, eos=0, continuous=False,
+                      queue_depth=8)
+    s.start()
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        ta, a = _submit_async(s, prompt, 12)
+        _wait(lambda: len(fr.step_actives) >= 2)
+        tb, b = _submit_async(s, prompt, 2)
+        ta.join(10.0)
+        tb.join(10.0)
+        assert len(a["tokens"]) == 12 and len(b["tokens"]) == 2
+        # B never joined A's in-flight batch...
+        assert all(len(act) == 1 for act in fr.step_actives)
+        # ...and despite being 6x shorter, finished after A (blocked)
+        assert b["done_at"] > a["done_at"]
+    finally:
+        s.close()
+    assert s.stats()["batching"] == "request"
+
+
+def test_scheduler_exception_reaches_all_clients():
+    """A runner exception latches the scheduler dead and fans out to
+    every active AND later request — clients get the error, never a
+    hang (the MicroBatcher discipline at step granularity)."""
+    fr = FakeRunner(slots=2, fail_after=3)
+    s = StepScheduler(fr, max_new_tokens=40, eos=0, queue_depth=8)
+    s.start()
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        ta, a = _submit_async(s, prompt, 30)
+        tb, b = _submit_async(s, prompt, 30)
+        ta.join(5.0)
+        tb.join(5.0)
+        assert not ta.is_alive() and not tb.is_alive()
+        assert isinstance(a["error"], RuntimeError)
+        assert isinstance(b["error"], RuntimeError)
+        with pytest.raises(RuntimeError, match="device fell over"):
+            s.submit(prompt, 2)
+    finally:
+        s.close()
+
+
+def test_scheduler_rejects_oversize_prompt_and_close():
+    fr = FakeRunner(slots=1, max_seqlen=4)
+    s = StepScheduler(fr, max_new_tokens=4, eos=0)
+    s.start()
+    with pytest.raises(ValueError, match="cache holds"):
+        s.submit(np.arange(5, dtype=np.int32))
+    s.close()
+    s.close()  # idempotent
+    with pytest.raises(ServeClosed):
+        s.submit(np.asarray([1], np.int32))
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxxnet-decode")]
+
+
+# --------------------------------------------- scheduler over the real engine
+
+def test_continuous_batching_matches_serial_greedy(engine):
+    """Concurrent mixed-length generation through the step scheduler is
+    token-identical to serial single-slot greedy decoding: slot
+    placement, join order, and batch composition never leak into the
+    sampled sequences (the bitwise-parity property, end to end)."""
+    prompts = [_prompt(3 + (i % 5), seed=100 + i) for i in range(6)]
+    lens = [4 + (i % 3) for i in range(6)]
+
+    def serial(p, n):
+        logits = engine.prefill(0, p)
+        seq = [int(np.argmax(logits))]
+        pos = len(p)
+        while len(seq) < n:
+            step = engine.step(np.asarray([seq[-1], 0], np.int32),
+                               np.asarray([pos, 0], np.int32))
+            seq.append(int(np.argmax(step[0])))
+            pos += 1
+        return seq
+
+    want = [serial(p, n) for p, n in zip(prompts, lens)]
+    s = StepScheduler(engine, max_new_tokens=8, eos=-1, queue_depth=8)
+    s.start()
+    got = [None] * 6
+    try:
+        def client(i):
+            got[i] = s.submit(prompts[i], lens[i])
+
+        ths = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(6)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+    finally:
+        s.close()
+    assert got == want
+    assert engine.retraces == 0
+
+
+# ------------------------------------------------------------- CLI task=serve
+
+@pytest.fixture(scope="module")
+def trained_lm(tmp_path_factory):
+    """A 1-layer LM trained for one round over a synthetic packed
+    corpus — the snapshot + token shards the serve_gen CLI run loads."""
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.models import transformer
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from make_synth_text import gen_docs
+    from cxxnet_tpu.io.text import write_token_shard
+    tmp_path = tmp_path_factory.mktemp("decode_cli")
+    docs = gen_docs(60, vocab=64, mean_len=24, seed=3)
+    for sh in range(2):
+        write_token_shard(str(tmp_path / f"c_{sh}.tok"),
+                          docs[sh::2], itemsize=2)
+    net = transformer(vocab=64, seq=32, dim=32, nlayer=1, nhead=2,
+                      packed=True)
+    conf = tmp_path / "train.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = text
+  path_tok = {tmp_path}/c_%d.tok
+  tok_count = 2
+iter = packseq
+  seqlen = 32
+iter = end
+{net}
+batch_size = 4
+num_round = 1
+model_dir = {tmp_path}/models
+save_model = 1
+updater = sgd
+eta = 0.05
+silent = 1
+""")
+    assert LearnTask().run([str(conf)]) == 0
+    return tmp_path, net, str(tmp_path / "models" / "0001.model")
+
+
+def test_cli_serve_gen_end_to_end(trained_lm):
+    """task=serve + serve_gen=1 through the real CLI: every pred-stream
+    prompt gets its generated ids in name_pred, the serve_gen record
+    lands with ZERO retraces (the two-executable contract under real
+    concurrent traffic), per-token/per-request latency records carry
+    percentiles, and the prefill/decode/sample span stages ride the
+    request traces — the ISSUE 16 acceptance run."""
+    import json
+
+    from cxxnet_tpu.main import LearnTask
+    tmp_path, net, model = trained_lm
+    conf = tmp_path / "serve_gen.conf"
+    conf.write_text(f"""
+dev = cpu
+task = serve
+model_in = {model}
+pred = {tmp_path}/gen_out.txt
+iter = text
+  path_tok = {tmp_path}/c_%d.tok
+  tok_count = 2
+iter = packseq
+  seqlen = 32
+iter = end
+{net}
+batch_size = 4
+serve_gen = 1
+decode_slots = 2
+decode_max_seqlen = 32
+serve_gen_tokens = 5
+serve_gen_prompt = 4
+serve_clients = 3
+trace_sample = 2
+silent = 1
+metrics_sink = jsonl:{tmp_path}/gen_metrics.jsonl
+""")
+    assert LearnTask().run([str(conf)]) == 0
+    lines = open(tmp_path / "gen_out.txt").read().splitlines()
+    assert lines, "no generations written"
+    for ln in lines:
+        toks = [int(x) for x in ln.split()]
+        assert 1 <= len(toks) <= 5
+        assert all(0 <= t < 64 for t in toks)
+
+    recs = [json.loads(l) for l in open(tmp_path / "gen_metrics.jsonl")]
+    [gen] = [r for r in recs if r["kind"] == "serve_gen"]
+    assert gen["retraces"] == 0          # the acceptance criterion
+    assert gen["requests"] == len(lines)
+    assert gen["tokens"] == sum(len(l.split()) for l in lines)
+    assert gen["tokens_per_sec"] > 0
+    assert gen["slots"] == 2 and gen["max_seqlen"] == 32
+    assert gen["batching"] == "continuous"
+    assert sum(gen["occupancy_hist"].values()) == gen["steps"]
+    assert gen["footprint"]["kv_cache_bytes"] > 0
+    lat = {r["op"]: r for r in recs if r["kind"] == "latency"}
+    assert {"token", "gen"} <= set(lat)
+    for op in ("token", "gen"):
+        assert lat[op]["count"] > 0
+        assert 0 < lat[op]["p50"] <= lat[op]["p95"] <= lat[op]["p99"]
+    spans = [r for r in recs if r["kind"] == "span"]
+    kinds = {r["span"] for r in spans}
+    assert {"prefill", "decode", "sample", "request"} <= kinds
+    # decode/sample spans fan out over the riders they stepped for
+    riders = [r for r in spans if r["span"] in ("decode", "sample")]
+    assert riders and all(r["riders"] for r in riders)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxxnet-decode")
+                or t.name.startswith("cxxnet-serve-gen")]

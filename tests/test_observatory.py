@@ -575,3 +575,47 @@ def test_step_hlo_text_carries_scopes():
     assert "00-fc1" in hit and "02-fc2" in hit
     # cached: the second call is the same object (one AOT compile total)
     assert t.step_hlo_text() is txt
+
+
+# ------------------------------------------------- jax-free fast path
+
+def test_obsv_fast_path_stays_jax_free():
+    """Importing the package (and the monitor read-side obsv.py uses)
+    must NOT pull in jax — the PEP 562 lazy surface in
+    cxxnet_tpu/__init__.py keeps ~2.7 s of import cost off every
+    tools/obsv.py invocation.  Subprocess-asserted so a stray eager
+    import anywhere on this path fails loudly."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "import cxxnet_tpu\n"
+         "from cxxnet_tpu.monitor import diff, ledger, metrics, spans\n"
+         "assert 'jax' not in sys.modules, 'jax leaked into fast path'\n"
+         "assert 'cxxnet_tpu.nnet' not in sys.modules\n"
+         "cxxnet_tpu.NetTrainer  # lazy surface still resolves\n"
+         "assert 'jax' in sys.modules  # ...by importing on demand\n"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+
+
+def test_obsv_cli_runs_without_jax_import():
+    """The obsv CLI over the checked-in fixture: the report path must
+    work end to end in a jax-free interpreter (jax hidden from the
+    subprocess via a poisoned meta-path entry, so an accidental lazy
+    trigger fails rather than silently paying the import)."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "class _NoJax:\n"
+         "    def find_module(self, name, path=None):\n"
+         "        if name == 'jax' or name.startswith('jax.'):\n"
+         "            raise ImportError('jax import on the fast path')\n"
+         "sys.meta_path.insert(0, _NoJax())\n"
+         "sys.argv = ['obsv', r'%s', '--json']\n"
+         "sys.path.insert(0, 'tools')\n"
+         "import runpy\n"
+         "runpy.run_path('tools/obsv.py', run_name='__main__')\n"
+         % REPORT_FIXTURE],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    json.loads(r.stdout)

@@ -530,6 +530,79 @@ def test_lint_malformed_shapes_is_error():
     assert _findings_for(fs, "serve_shapes", "error")
 
 
+def _lm_serve_conf(extra="", causal=1, packed=False, seq=32):
+    from cxxnet_tpu.models import transformer
+    net = transformer(vocab=64, seq=seq, dim=32, nlayer=1, nhead=2,
+                      causal=causal, packed=packed)
+    return ("task = serve\nmodel_in = m.model\npred = out.txt\n"
+            "iter = text\n  path_tok = c_%d.tok\n  tok_count = 1\n"
+            f"iter = packseq\n  seqlen = {seq}\niter = end\n"
+            f"{net}batch_size = 4\nserve_gen = 1\n{extra}")
+
+
+def test_lint_decode_keys_warn_off_task():
+    fs = _lint("task = train\nserve_gen = 1\ndecode_slots = 4\n")
+    assert _findings_for(fs, "serve_gen", "warn")
+
+
+def test_lint_decode_detail_keys_warn_without_gen():
+    fs = _lint("task = serve\nmodel_in = m.model\npred = out.txt\n"
+               "iter = mnist\niter = end\ndecode_slots = 4\n")
+    assert _findings_for(fs, "decode_slots", "warn")
+
+
+def test_lint_serve_gen_needs_lm_netconfig():
+    """serve_gen over an MLP netconfig: incremental decode only speaks
+    token-id transformers — error, not a runtime surprise."""
+    fs = _lint("task = serve\nmodel_in = m.model\npred = out.txt\n"
+               "iter = mnist\niter = end\n" + MLP_NET
+               + "batch_size = 8\nserve_gen = 1\n")
+    assert _findings_for(fs, "serve_gen", "error")
+
+
+def test_lint_serve_gen_needs_causal_attention():
+    fs = _lint(_lm_serve_conf(causal=0))
+    assert _findings_for(fs, "causal", "error")
+    assert not _findings_for(_lint(_lm_serve_conf()), "causal")
+
+
+def test_lint_decode_max_seqlen_mismatches_are_errors():
+    """The prefill executable runs the net at its declared width and
+    prompts arrive at the packer's seqlen: both mismatches error."""
+    fs = _lint(_lm_serve_conf("decode_max_seqlen = 64\n"))
+    assert len(_findings_for(fs, "decode_max_seqlen", "error")) == 2
+    assert not _findings_for(
+        _lint(_lm_serve_conf("decode_max_seqlen = 32\n")),
+        "decode_max_seqlen")
+
+
+def test_lint_decode_kv_cache_over_hbm_is_error():
+    """The analytic KV-cache bytes (the live engine's footprint()
+    number) against the selected chip's HBM — the task=check memory
+    pre-flight, without tracing anything."""
+    fs = _lint(_lm_serve_conf("mem_chip = v5e\n"
+                              "decode_slots = 4000000\n"))
+    [f] = _findings_for(fs, "decode_slots", "error")
+    assert "HBM" in f.message
+    assert not _findings_for(
+        _lint(_lm_serve_conf("mem_chip = v5e\ndecode_slots = 4\n")),
+        "decode_slots")
+
+
+def test_lint_sampling_knob_consistency():
+    fs = _lint(_lm_serve_conf("serve_gen_temp = 0.7\n"))
+    assert _findings_for(fs, "serve_gen_temp", "warn")
+    fs = _lint(_lm_serve_conf("serve_gen_sample = temperature\n"
+                              "serve_gen_topk = 10\n"))
+    assert _findings_for(fs, "serve_gen_topk", "warn")
+    fs = _lint(_lm_serve_conf("serve_gen_sample = topk\n"))
+    assert _findings_for(fs, "serve_gen_sample", "warn")
+    assert not _findings_for(
+        _lint(_lm_serve_conf("serve_gen_sample = topk\n"
+                             "serve_gen_topk = 10\n")),
+        "serve_gen_sample")
+
+
 # ------------------------------------------------------------ wrapper path
 
 def test_wrapper_enable_serving_parity():

@@ -164,6 +164,19 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
               "queue_depth_max", "dtype", "shapes", "clients", "retraces",
               "quant_rel_err", "footprint") if k in r}
             for r in by["serve"]]
+    if by.get("serve_gen"):
+        # incremental-decode generation runs (doc/serve.md "Incremental
+        # decode"): aggregate tokens/sec, batch occupancy, per-token
+        # percentiles, and the zero-retrace contract
+        rep["generation"] = [
+            {k: r.get(k) for k in
+             ("model", "duration_sec", "tokens_per_sec", "slots",
+              "max_seqlen", "gen_tokens", "clients", "sample",
+              "retraces", "requests", "tokens", "steps", "prefills",
+              "mean_occupancy", "occupancy_hist", "batching",
+              "tok_p50_ms", "tok_p95_ms", "tok_p99_ms", "footprint")
+             if k in r}
+            for r in by["serve_gen"]]
     if by.get("span"):
         # request-path p99 decomposition (doc/monitor.md "Reading a
         # p99 breakdown"): per-stage latency percentiles + share of
@@ -410,6 +423,31 @@ def render(rep: dict) -> str:
         if errs:
             out.append(f"quantization pairtest vs f32: max rel err "
                        f"{_fmt(max(errs), 4)}")
+    gen = rep.get("generation")
+    if gen:
+        out.append("")
+        n_retr = sum(r.get("retraces") or 0 for r in gen)
+        out.append(
+            f"generation: {len(gen)} run(s); decode retraces past "
+            f"warmup: {n_retr}"
+            + ("" if not n_retr else "  <-- a shape escaped the two "
+               "pinned executables"))
+        out.append(_table(
+            ["model", "batching", "tok/s", "requests", "tokens",
+             "steps", "occ", "tok_p99", "kv_cache"],
+            [[str(r.get("model", "?")), str(r.get("batching", "?")),
+              _fmt(r.get("tokens_per_sec"), 1), _fmt(r.get("requests")),
+              _fmt(r.get("tokens")), _fmt(r.get("steps")),
+              _fmt(r.get("mean_occupancy")), _fmt(r.get("tok_p99_ms")),
+              _mb((r.get("footprint") or {}).get("kv_cache_bytes"))]
+             for r in gen]))
+        hist = gen[-1].get("occupancy_hist") or {}
+        if hist:
+            total = sum(hist.values()) or 1
+            out.append("batch occupancy (last run): " + "  ".join(
+                f"{k}x{v} ({v / total:.0%})"
+                for k, v in sorted(hist.items(),
+                                   key=lambda kv: int(kv[0]))))
     dec = rep.get("serve_stages")
     if dec:
         out.append("")
