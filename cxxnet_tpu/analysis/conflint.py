@@ -247,6 +247,14 @@ def _as_int(last: Dict[str, str], key: str, default: int = 0) -> int:
         return default
 
 
+def _as_float(last: Dict[str, str], key: str,
+              default: float = 0.0) -> float:
+    try:
+        return float(last.get(key, default))
+    except ValueError:
+        return default
+
+
 def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
                      sections_seen: Dict[int, int],
                      findings: List[Finding]) -> None:
@@ -500,7 +508,11 @@ def _serve_rules(last: Dict[str, str], task: str, add) -> None:
         for k in ("serve_shapes", "serve_max_batch", "serve_max_wait_ms",
                   "serve_dtype", "serve_clients", "serve_calib",
                   "serve_queue_depth", "serve_sentinel",
-                  "serve_sentinel_window"):
+                  "serve_sentinel_window", "serve_admin_port",
+                  "serve_slo_p99_ms", "serve_slo_avail",
+                  "serve_slo_fast_sec", "serve_slo_slow_sec",
+                  "serve_slo_fast_burn", "serve_slo_slow_burn",
+                  "serve_flight_requests", "serve_flight_boost"):
             if k in last:
                 add(Finding("warn", k,
                             f"{k} has no effect without task = serve"))
@@ -524,6 +536,46 @@ def _serve_rules(last: Dict[str, str], task: str, add) -> None:
                     "(serve_calib = N): the quantized variant ships "
                     "without its pairtest-vs-f32 error being measured "
                     "on real request data"))
+    # -- live control plane (serve/admin.py, monitor/slo.py).  The
+    # serve_admin_port RANGE is the KeySpec's lo/hi (0..65535, an
+    # error at schema level); these rules cover the cross-key wiring.
+    if _as_float(last, "serve_slo_p99_ms", 0.0) > 0.0 \
+            and not _as_int(last, "serve_sentinel", 0):
+        add(Finding("warn", "serve_slo_p99_ms",
+                    "serve_slo_p99_ms without serve_sentinel = 1: the "
+                    "SLO burn rates evaluate over the sentinel "
+                    "reporter's serve_window stream, so the targets "
+                    "are ignored"))
+    win = _as_float(last, "serve_sentinel_window", 1.0)
+    if win > 0:
+        for k in ("serve_slo_fast_sec", "serve_slo_slow_sec"):
+            if k not in last:
+                continue
+            sec = _as_float(last, k, 0.0)
+            ratio = sec / win
+            if sec > 0 and abs(ratio - round(ratio)) > 1e-9:
+                add(Finding("error", k,
+                            f"{k} = {sec:g} is not an integer multiple "
+                            f"of serve_sentinel_window ({win:g}): the "
+                            "burn window is a whole number of reporter "
+                            "windows, so a fractional multiple "
+                            "silently rounds"))
+    fast = _as_float(last, "serve_slo_fast_sec", 60.0)
+    slow = _as_float(last, "serve_slo_slow_sec", 600.0)
+    if ("serve_slo_fast_sec" in last or "serve_slo_slow_sec" in last) \
+            and fast >= slow:
+        add(Finding("warn", "serve_slo_fast_sec",
+                    f"serve_slo_fast_sec ({fast:g}) >= "
+                    f"serve_slo_slow_sec ({slow:g}): the fast tier "
+                    "should be the SHORTER window (acute outages), "
+                    "the slow one the longer (simmering regressions)"))
+    if ("serve_flight_requests" in last or "serve_flight_boost" in last) \
+            and not _as_int(last, "serve_sentinel", 0):
+        add(Finding("warn", "serve_flight_requests",
+                    "serve_flight_* keys without serve_sentinel = 1: "
+                    "flight capture triggers from sentinel anomalies "
+                    "or SLO burns, which both ride the sentinel "
+                    "reporter"))
     shapes_str = last.get("serve_shapes", "")
     if shapes_str:
         from ..serve import shapes_check

@@ -1377,15 +1377,30 @@ class LearnTask:
         emits per-token + per-request ``latency`` records and one
         ``serve_gen`` record (tokens/sec, occupancy histogram, retrace
         count — the telemetry ``bench.py --lm-serve`` sweeps)."""
-        from .serve.host import GenModel
+        from .serve.host import GenModel, ModelHost
         metrics = self.net.metrics
         gm = GenModel(self.net, cfg, metrics=metrics)
+        # admin plane (serve/admin.py): same lifecycle as task_serve —
+        # endpoint up before warmup (503 /readyz through compilation),
+        # ready only once both decode executables are pinned.  The
+        # generation path has no sentinel reporter, so /statusz shows
+        # live scheduler counters without a last-window row and the
+        # SLO keys ride only the classic serve path (doc/serve.md)
+        host = ModelHost()
+        host.attach(gm, warmup=False)
+        admin = None
+        if cfg.admin_port:
+            import dataclasses as _dc
+            admin = host.start_admin(metrics, port=cfg.admin_port,
+                                     config=_dc.asdict(cfg))
         mlog.notice(
             f"serve: warming decode engine ({cfg.slots} slot(s), "
             f"max_seqlen {gm.engine.max_seqlen}, 2 executables) ...")
         gm.warmup()
         mlog.info(f"serve: decode warmup compiled in "
                   f"{gm.engine.warmup_sec:.1f} sec")
+        if not host.mark_ready():
+            mlog.warn("serve: host failed the ready admission check")
         footprint = gm.footprint()
         if footprint:
             metrics.set_gauge("serve_footprint_bytes",
@@ -1505,7 +1520,7 @@ class LearnTask:
                 f"{stats['mean_occupancy']}, "
                 f"{stats['batching']} batching), retraces {gm.retraces}")
         finally:
-            gm.close()
+            host.close()   # not-ready first, scheduler drain, admin join
         mlog.notice(f"finished serving, wrote {self.name_pred}")
 
     def task_serve(self) -> None:
@@ -1530,6 +1545,19 @@ class LearnTask:
             return self.task_serve_gen(cfg)
         metrics = self.net.metrics
         sm = ServeModel(self.net, cfg, metrics=metrics)
+        # live control plane (serve/admin.py, doc/serve.md "Operating a
+        # serve host"): the host carries the ready lifecycle and owns
+        # the admin endpoint, which starts BEFORE warmup so /readyz
+        # reads 503 while executables compile — the hot-swap admission
+        # window a poller must see as not-yet-ready
+        from .serve.host import ModelHost
+        host = ModelHost()
+        host.attach(sm, warmup=False)
+        admin = None
+        if cfg.admin_port:
+            import dataclasses as _dc
+            admin = host.start_admin(metrics, port=cfg.admin_port,
+                                     config=_dc.asdict(cfg))
         mlog.notice(
             f"serve: warming {len(cfg.shapes)} shape bucket(s) "
             f"{list(cfg.shapes)}, dtype={cfg.dtype} ...")
@@ -1590,6 +1618,50 @@ class LearnTask:
                                     warmup=self.sentinel_warmup,
                                     ring=self.sentinel_ring)
                 sm.batcher.track_window = True
+        # SLO burn-rate alerting (monitor/slo.py) + anomaly-triggered
+        # flight capture (serve/admin.py) ride the sentinel reporter's
+        # serve_window stream: the batcher counts per-window budget
+        # violations, the tracker evaluates fast/slow burn windows,
+        # and either a burn or a sentinel anomaly arms ONE flight —
+        # trace_sample boosted for the next serve_flight_requests
+        # requests, then a serve_flight record with the window ring
+        # and the captured trace_id range
+        slo = None
+        flight = None
+        if bank is not None:
+            from .serve.admin import FlightCapture
+            flight = FlightCapture(
+                metrics, lambda: sm.batcher.n_requests, model=sm.name,
+                boost=cfg.flight_boost, requests=cfg.flight_requests,
+                stats_fn=sm.batcher.stats)
+            bank.on_anomaly = lambda hit: flight.trigger(
+                f"anomaly: {hit['metric']} {hit['direction']} "
+                f"{hit['rel_dev']:+.0%}")
+            if cfg.slo_p99_ms > 0.0:
+                from .monitor.slo import SloSpec, SloTracker
+                sm.batcher.slo_ms = cfg.slo_p99_ms
+                slo = SloTracker(
+                    SloSpec(p99_ms=cfg.slo_p99_ms, avail=cfg.slo_avail,
+                            fast_sec=cfg.slo_fast_sec,
+                            slow_sec=cfg.slo_slow_sec,
+                            fast_burn=cfg.slo_fast_burn,
+                            slow_burn=cfg.slo_slow_burn),
+                    cfg.sentinel_window, metrics=metrics,
+                    model=sm.name,
+                    on_burn=lambda rec: flight.trigger(
+                        f"slo: {rec['tier']} burn {rec['burn']:.1f} "
+                        f">= {rec['threshold']:g}"))
+        elif cfg.slo_p99_ms > 0.0:
+            mlog.warn("serve_slo_p99_ms without serve_sentinel = 1 "
+                      "(and an active metrics_sink): the SLO evaluates "
+                      "over the sentinel reporter's serve_window "
+                      "stream; targets ignored")
+        if admin is not None:
+            admin.slo = slo
+            admin.flight = flight
+            # even without sentinels, the reporter feeds /statusz its
+            # last-window QPS/p99 — scraping needs the window stream
+            sm.batcher.track_window = True
         # stream the request iterator: each VALID row of each pred batch
         # becomes one single-row request (round_batch padding excluded,
         # like predict_raw) fed through a BOUNDED work queue — the
@@ -1678,16 +1750,31 @@ class LearnTask:
                        "requests": ws["requests"],
                        "qps": round(ws["requests"] / dt, 2),
                        "queue_depth": ws["queue_depth"]}
+                if "viol" in ws:
+                    rec["viol"] = ws["viol"]
                 for k in ("p50_ms", "p95_ms", "p99_ms"):
                     if k in ws:
                         rec[k] = ws[k]
                 metrics.emit("serve_window", **rec)
+                # the admin plane caches the window for /statusz (and
+                # the flight ring) via whole-object swaps — the scrape
+                # path reads it without ever touching this thread's
+                # locks
+                if admin is not None:
+                    admin.note_window(sm.name, rec)
+                elif flight is not None:
+                    flight.note_window(rec)
                 # every window feeds the bank: an idle one (requests=0,
                 # so qps/p99 are falsy and skipped inside observe_serve)
                 # still drives the queue-depth watcher — a dispatcher
                 # stall grows the queue while NOTHING completes, the
                 # exact window the depth sentinel exists for
-                bank.observe_serve(rec)
+                if bank is not None:
+                    bank.observe_serve(rec)
+                if slo is not None:
+                    slo.observe(rec)
+                if flight is not None:
+                    flight.tick()
 
             try:
                 while not stop_evt.wait(cfg.sentinel_window):
@@ -1701,13 +1788,19 @@ class LearnTask:
                 mlog.warn(f"serve sentinel reporter died: {e!r}; "
                           "serve_window records stop here")
 
+        # admission: every executable pinned, calibration done, zero
+        # retraces — /readyz flips 200 here and a poller may now route
+        if not host.mark_ready():
+            mlog.warn("serve: host failed the ready admission check")
         t0 = time.perf_counter()
         threads = [threading.Thread(target=client, daemon=True,
                                     name=f"cxxnet-serve-client-{j}")
                    for j in range(cfg.clients)]
         prod = threading.Thread(target=producer, daemon=True,
                                 name="cxxnet-serve-producer")
-        if bank is not None:
+        if bank is not None or admin is not None:
+            # the reporter drives sentinels AND the admin plane's
+            # last-window cache; either consumer starts it
             sentinel_stop = threading.Event()
             sentinel_thread = threading.Thread(
                 target=reporter, args=(sentinel_stop,), daemon=True,
@@ -1764,7 +1857,9 @@ class LearnTask:
             if sentinel_stop is not None:
                 sentinel_stop.set()
                 sentinel_thread.join()
-            sm.close()
+            # host.close() flips /readyz to 503 BEFORE the batcher
+            # drains, then joins the admin endpoint last
+            host.close()
         mlog.notice(f"finished serving, wrote {self.name_pred}")
 
     def _emit_ledger(self) -> None:

@@ -162,6 +162,9 @@ class MetricsRegistry:
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
         self.sink: Optional[JsonlSink] = None
+        # registry birth stamp: the admin plane's /statusz uptime and
+        # the promtext scrape both date from here (serve/admin.py)
+        self.created = time.time()
         from .spans import SpanTracer
         self.tracer = SpanTracer(self)
 

@@ -102,7 +102,8 @@ class SentinelBank:
     check time — sentinel thresholds require ``metrics_sink``)."""
 
     def __init__(self, metrics: MetricsRegistry, rel: float = 0.2,
-                 warmup: int = 3, ring: int = 64, alpha: float = 0.3):
+                 warmup: int = 3, ring: int = 64, alpha: float = 0.3,
+                 on_anomaly=None):
         if rel <= 0:
             # a zero/negative threshold fires on every post-warmup
             # observation — an anomaly-plus-flight storm, never intended
@@ -130,6 +131,10 @@ class SentinelBank:
                                           rel, warmup, alpha),
         }
         self.anomalies: List[Dict] = []
+        # optional anomaly callback (serve/admin.FlightCapture.trigger
+        # rides here): called AFTER the anomaly/flight records land, so
+        # a failing hook can never cost the primary evidence
+        self.on_anomaly = on_anomaly
 
     # ---------------------------------------------------- resume state
     def state(self) -> Dict:
@@ -194,6 +199,13 @@ class SentinelBank:
         self.metrics.emit("anomaly", **hit)
         self.flight_dump(f"anomaly: {name} {hit['direction']} "
                          f"{hit['rel_dev']:+.0%} vs ewma")
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(hit)
+            except Exception as e:  # noqa: BLE001 — a capture-hook
+                # failure must not kill the reporter thread
+                from . import log
+                log.warn(f"sentinel on_anomaly hook failed: {e}")
 
     # ------------------------------------------------------ flight ring
     def flight_dump(self, reason: str) -> None:

@@ -1,0 +1,156 @@
+"""SLO burn-rate alerting over the ``serve_window`` record stream.
+
+The serve sentinels (monitor/sentinel.py) detect CHANGE — an EWMA
+deviation fires on any sustained shift, good baseline or bad.  An SLO
+is the opposite contract: an absolute target (``serve_slo_p99_ms``, a
+latency threshold, plus ``serve_slo_avail``, the fraction of requests
+that must meet it) and an error BUDGET (``1 - avail``) spent by every
+request over the threshold.  Burn rate is budget spend velocity:
+``burn = error_rate / budget`` — burn 1.0 spends exactly the budget
+over the SLO period, burn 14.4 exhausts a 30-day budget in 2 days.
+
+Multi-window evaluation (the standard fast/slow pair): the FAST window
+(``serve_slo_fast_sec``, high threshold ``serve_slo_fast_burn``)
+catches an acute outage in seconds; the SLOW window
+(``serve_slo_slow_sec``, lower ``serve_slo_slow_burn``) catches a
+simmering regression the fast window keeps forgetting.  Both windows
+are rings of ``serve_window`` records (the sentinel reporter's
+cadence, ``serve_sentinel_window`` seconds each — graftlint enforces
+the window seconds divide evenly into records).
+
+The verdict is judged through the ONE comparison engine
+(:func:`monitor.diff.compare`, direction + floor semantics) — the same
+code path that judges an offline A/B, so the serve admission gate
+(ROADMAP item 4: canary promotion on hot-swap) and the live alert can
+never disagree about what "over budget" means.  A firing tier emits
+one ``slo`` JSONL record on the rising edge (doc/monitor.md) and holds
+``firing`` until the burn drops back under threshold; the latest
+verdict dict is kept for ``/statusz`` (atomic whole-object swap — the
+admin scrape path reads it without locks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from .diff import LOWER_BETTER, compare
+
+
+@dataclasses.dataclass
+class SloSpec:
+    """Declared serving SLO (serve/__init__.py keys -> here)."""
+
+    p99_ms: float = 0.0        # latency threshold; 0 disables the SLO
+    avail: float = 0.999       # fraction of requests under threshold
+    fast_sec: float = 60.0     # acute window
+    slow_sec: float = 600.0    # simmering window
+    fast_burn: float = 14.4    # firing threshold, fast tier
+    slow_burn: float = 6.0     # firing threshold, slow tier
+
+    def __post_init__(self):
+        if self.p99_ms > 0.0 and not (0.0 < self.avail < 1.0):
+            raise ValueError(
+                f"serve_slo_avail = {self.avail}: must be in (0, 1) — "
+                "1.0 leaves a zero error budget, which no burn rate "
+                "can be computed against")
+        if self.fast_sec <= 0 or self.slow_sec <= 0:
+            raise ValueError("SLO burn windows must be > 0 seconds")
+
+    @property
+    def active(self) -> bool:
+        return self.p99_ms > 0.0
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.avail
+
+
+class SloTracker:
+    """Feed :meth:`observe` one ``serve_window`` record per reporter
+    tick; it maintains both burn windows, emits ``slo`` records on
+    rising edges, and keeps the latest verdict for ``/statusz``.
+
+    The record must carry ``requests`` and ``viol`` (requests whose
+    latency exceeded ``p99_ms`` — the batcher counts them per window
+    when armed with ``slo_ms``); ``window_sec`` sizes the rings on
+    first observation.
+    """
+
+    def __init__(self, spec: SloSpec, window_sec: float, *,
+                 metrics=None, model: str = "default",
+                 on_burn: Optional[Callable[[dict], Any]] = None):
+        self.spec = spec
+        self.metrics = metrics
+        self.model = model
+        self.on_burn = on_burn
+        win = max(float(window_sec), 1e-9)
+        self._tiers: Dict[str, dict] = {}
+        for tier, sec, thresh in (
+                ("fast", spec.fast_sec, spec.fast_burn),
+                ("slow", spec.slow_sec, spec.slow_burn)):
+            n = max(1, int(math.ceil(sec / win - 1e-9)))
+            self._tiers[tier] = {
+                "sec": sec, "threshold": thresh, "firing": False,
+                "ring": deque(maxlen=n), "burn": 0.0}
+        # latest verdict, swapped whole so /statusz reads it lock-free
+        self.verdict: Dict[str, Any] = self._verdict()
+
+    # ------------------------------------------------------------ observe
+    def observe(self, rec: Dict[str, Any]) -> Optional[dict]:
+        """One reporter window.  Returns the ``slo`` record dict when a
+        tier crosses onto firing this tick (the flight-capture trigger),
+        else None."""
+        if not self.spec.active:
+            return None
+        requests = int(rec.get("requests", 0))
+        viol = int(rec.get("viol", 0))
+        fired: Optional[dict] = None
+        for tier, st in self._tiers.items():
+            st["ring"].append((requests, viol))
+            total = sum(r for r, _ in st["ring"])
+            bad = sum(v for _, v in st["ring"])
+            error_rate = bad / total if total else 0.0
+            burn = error_rate / self.spec.budget
+            st["burn"] = burn
+            # the ONE comparison engine judges the threshold crossing:
+            # candidate burn vs the declared ceiling, LOWER_BETTER,
+            # zero tolerance (any excursion past the ceiling regresses)
+            judge = compare(f"slo_{tier}_burn", a=st["threshold"],
+                            b=burn, rel=0.0, direction=LOWER_BETTER)
+            now_firing = bool(judge["regressed"])
+            if now_firing and not st["firing"]:
+                out = {"model": self.model, "tier": tier,
+                       "burn": round(burn, 4),
+                       "threshold": st["threshold"],
+                       "budget": self.spec.budget,
+                       "error_rate": round(error_rate, 6),
+                       "requests": total, "viol": bad,
+                       "window_sec": st["sec"],
+                       "rel_delta": judge["rel_delta"]}
+                if self.metrics is not None:
+                    self.metrics.counter_inc("slo_burns")
+                    self.metrics.emit("slo", **out)
+                if fired is None:
+                    fired = out
+            st["firing"] = now_firing
+        self.verdict = self._verdict()
+        if fired is not None and self.on_burn is not None:
+            self.on_burn(fired)
+        return fired
+
+    # ------------------------------------------------------------ verdict
+    def _verdict(self) -> Dict[str, Any]:
+        tiers = {tier: {"burn": round(st["burn"], 4),
+                        "threshold": st["threshold"],
+                        "window_sec": st["sec"],
+                        "firing": st["firing"]}
+                 for tier, st in self._tiers.items()}
+        return {"active": self.spec.active,
+                "p99_ms_target": self.spec.p99_ms,
+                "avail_target": self.spec.avail,
+                "budget": self.spec.budget,
+                "ok": not any(t["firing"] for t in tiers.values()),
+                **tiers}

@@ -159,6 +159,15 @@ class SpanTracer:
         grabbed ``metrics.tracer`` early see the change."""
         self.sample = int(sample)
 
+    @property
+    def watermark(self) -> int:
+        """The last allocated trace_id (GIL-atomic int read, no lock):
+        two watermark reads bracket an id RANGE, which is how the
+        flight capture (serve/admin.py) names the spans it boosted —
+        ``serve_flight`` records carry ``trace_first``/``trace_last``
+        from exactly this."""
+        return self._next_id
+
     @staticmethod
     def now() -> float:
         return time.perf_counter()
@@ -254,6 +263,7 @@ class NullTracer:
 
     sample = 0
     enabled = False
+    watermark = 0
 
     def new_trace(self):
         return None

@@ -122,6 +122,35 @@ SERVE_KEYS = (
       help="continuous = requests join/leave the decode batch between "
            "steps; request = fill a batch and run it to completion "
            "(the A/B baseline)"),
+    # -- live control plane (serve/admin.py, doc/serve.md "Operating a
+    #    serve host")
+    K("serve_admin_port", "int", lo=0, hi=65535,
+      help="in-process admin HTTP endpoint (/metrics /healthz /readyz "
+           "/statusz) on this port; 0 = off (the range check IS the "
+           "lint: 1-65535 to enable)"),
+    K("serve_slo_p99_ms", "float", lo=0.0,
+      help="latency SLO threshold: requests slower than this spend "
+           "error budget (monitor/slo.py); 0 = SLO off"),
+    K("serve_slo_avail", "float", lo=0.0, hi=1.0,
+      help="fraction of requests that must meet serve_slo_p99_ms "
+           "(budget = 1 - avail); must be < 1.0 when the SLO is on"),
+    K("serve_slo_fast_sec", "float", lo=0.01,
+      help="fast burn window seconds (acute outage tier); must be an "
+           "integer multiple of serve_sentinel_window"),
+    K("serve_slo_slow_sec", "float", lo=0.01,
+      help="slow burn window seconds (simmering regression tier); "
+           "must be an integer multiple of serve_sentinel_window"),
+    K("serve_slo_fast_burn", "float", lo=1e-6,
+      help="fast-tier firing threshold (budget-spend velocity; 14.4 "
+           "= a 30-day budget gone in 2 days)"),
+    K("serve_slo_slow_burn", "float", lo=1e-6,
+      help="slow-tier firing threshold"),
+    K("serve_flight_requests", "int", lo=1,
+      help="anomaly flight capture: boost trace_sample for this many "
+           "requests before dumping the serve_flight record"),
+    K("serve_flight_boost", "int", lo=1,
+      help="trace_sample value while a flight capture is armed (1 = "
+           "trace every request)"),
 )
 
 
@@ -151,6 +180,16 @@ class ServeConfig:
     gen_eos: int = -1
     gen_prompt: int = 8
     gen_batching: str = "continuous"
+    # live control plane (serve/admin.py) + SLO (monitor/slo.py)
+    admin_port: int = 0         # 0 = no admin endpoint
+    slo_p99_ms: float = 0.0     # 0 = no SLO
+    slo_avail: float = 0.999
+    slo_fast_sec: float = 60.0
+    slo_slow_sec: float = 600.0
+    slo_fast_burn: float = 14.4
+    slo_slow_burn: float = 6.0
+    flight_requests: int = 16
+    flight_boost: int = 1
 
     def __post_init__(self):
         if self.sentinel_window <= 0:
@@ -180,6 +219,17 @@ class ServeConfig:
         if self.gen_sample == "topk" and self.gen_topk < 1:
             raise ValueError(
                 "serve_gen_sample = topk requires serve_gen_topk >= 1")
+        if not 0 <= self.admin_port <= 65535:
+            raise ValueError(
+                f"serve_admin_port = {self.admin_port}: expected "
+                "0 (off) or a port in 1..65535")
+        if self.slo_p99_ms > 0.0 and not 0.0 < self.slo_avail < 1.0:
+            raise ValueError(
+                f"serve_slo_avail = {self.slo_avail}: must be in "
+                "(0, 1) when serve_slo_p99_ms is set (1.0 leaves a "
+                "zero error budget)")
+        if self.slo_fast_sec <= 0 or self.slo_slow_sec <= 0:
+            raise ValueError("serve_slo_*_sec windows must be > 0")
 
     @classmethod
     def from_pairs(cls, pairs: Sequence[Tuple[str, str]]) -> "ServeConfig":
@@ -210,7 +260,23 @@ class ServeConfig:
                                  ("serve_gen_eos", "gen_eos", int),
                                  ("serve_gen_prompt", "gen_prompt", int),
                                  ("serve_gen_batching",
-                                  "gen_batching", str)):
+                                  "gen_batching", str),
+                                 ("serve_admin_port", "admin_port", int),
+                                 ("serve_slo_p99_ms", "slo_p99_ms",
+                                  float),
+                                 ("serve_slo_avail", "slo_avail", float),
+                                 ("serve_slo_fast_sec", "slo_fast_sec",
+                                  float),
+                                 ("serve_slo_slow_sec", "slo_slow_sec",
+                                  float),
+                                 ("serve_slo_fast_burn", "slo_fast_burn",
+                                  float),
+                                 ("serve_slo_slow_burn", "slo_slow_burn",
+                                  float),
+                                 ("serve_flight_requests",
+                                  "flight_requests", int),
+                                 ("serve_flight_boost", "flight_boost",
+                                  int)):
             if key in last:
                 kw[field] = conv(last[key])
         return cls(**kw)

@@ -1,0 +1,355 @@
+"""Live serving control plane: the in-process admin HTTP endpoint.
+
+``serve_admin_port = N`` starts one stdlib ``http.server`` thread
+inside the serve task (owned by :class:`~cxxnet_tpu.serve.host.
+ModelHost`, joined by its ``close()``), turning the post-hoc JSONL
+observability stack into something a load balancer can health-check
+and a scraper can poll while the host is under load:
+
+* ``/metrics``  — the live MetricsRegistry in Prometheus text format
+  (monitor/promtext.py), plus exact ``le``-bucket histograms for the
+  batcher's batch-size and the scheduler's occupancy distributions.
+* ``/healthz``  — 200 while the process serves (liveness).
+* ``/readyz``   — 200 only while ``ModelHost.ready`` holds: every
+  model warmed, executables pinned, ``retraces == 0``; 503 during
+  warmup and from the moment ``close()`` begins (the hot-swap
+  admission signal ROADMAP item 4 gates on).
+* ``/statusz``  — per-model JSON: QPS / p99 over the last reporter
+  window, queue depth, batch/occupancy histograms, ``footprint()``
+  bytes, retraces, uptime, the config echo, and the SLO verdict
+  (monitor/slo.py).
+
+THE scrape-path rule (asserted by tests/test_admin.py): handlers never
+take the dispatcher's locks.  Counters/gauges are GIL-atomic dict
+reads, histogram summaries come from ``snapshot()`` copies, the last
+window record and the SLO verdict are whole-object swaps, and the one
+hazard left — copying a dict the dispatcher is growing — is handled by
+:func:`copy_racy` (bounded retry on the "changed size during
+iteration" race), not by locking the writer.
+
+:class:`FlightCapture` closes the anomaly loop: when a serve sentinel
+or an SLO burn fires, it boosts ``trace_sample`` for the next
+``serve_flight_requests`` requests, snapshots batcher/scheduler stats,
+and emits one ``serve_flight`` record carrying the recent
+``serve_window`` ring and the captured span trace_id range — a p99
+spike leaves a diagnosable corpse instead of a bare anomaly line.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from ..monitor import log as mlog
+from ..monitor import promtext
+
+
+def copy_racy(d: Dict, tries: int = 8) -> Dict:
+    """Copy a dict another thread may be growing, WITHOUT locking the
+    writer: dict iteration is GIL-consistent but raises RuntimeError if
+    an insert lands mid-copy — rare, so a bounded retry converges; the
+    final attempt falls back to an item-at-a-time copy that tolerates
+    concurrent growth."""
+    for _ in range(tries):
+        try:
+            return dict(d)
+        except RuntimeError:
+            continue
+    out = {}
+    for k in list(d.keys()):
+        try:
+            out[k] = d[k]
+        except KeyError:
+            continue
+    return out
+
+
+class FlightCapture:
+    """Anomaly-triggered span boost + one ``serve_flight`` record.
+
+    Armed by :meth:`trigger` (from a sentinel anomaly or an SLO burn —
+    idempotent while armed, so a storm of anomalies yields ONE flight);
+    :meth:`tick` runs once per reporter window and completes the
+    capture after ``requests`` boosted requests (or ``max_ticks``
+    windows, so a dead-air host still lands its record)."""
+
+    def __init__(self, metrics, count_fn: Callable[[], int], *,
+                 model: str = "default", boost: int = 1,
+                 requests: int = 16, max_ticks: int = 10,
+                 ring: int = 8,
+                 stats_fn: Optional[Callable[[], dict]] = None):
+        self.metrics = metrics
+        self.count_fn = count_fn          # lock-free served-request count
+        self.model = model
+        self.boost = max(1, int(boost))
+        self.requests = max(1, int(requests))
+        self.max_ticks = max(1, int(max_ticks))
+        self.stats_fn = stats_fn
+        self._ring: deque = deque(maxlen=max(1, int(ring)))
+        self._lock = threading.Lock()
+        self.armed = False
+        self._reason = ""
+        self._prev_sample = 0
+        self._wm0 = 0
+        self._n0 = 0
+        self._ticks = 0
+
+    def note_window(self, rec: dict) -> None:
+        """Ring of recent ``serve_window`` records — the flight's
+        context payload (kept here, NOT in the sentinel bank: its ring
+        clears on every ``flight_dump``)."""
+        self._ring.append(dict(rec))
+
+    def trigger(self, reason: str) -> bool:
+        """Arm the capture; False when already armed (one flight per
+        storm)."""
+        with self._lock:
+            if self.armed:
+                return False
+            tracer = self.metrics.tracer
+            self._prev_sample = tracer.sample
+            self._wm0 = tracer.watermark
+            self._n0 = self.count_fn()
+            self._ticks = 0
+            self._reason = str(reason)
+            self.armed = True
+            tracer.configure(self.boost)
+        mlog.info(f"serve flight armed ({self._reason}): trace_sample "
+                  f"-> {self.boost} for next {self.requests} requests")
+        return True
+
+    def tick(self) -> Optional[dict]:
+        """One reporter window; returns the ``serve_flight`` record
+        when the capture completes this tick, else None."""
+        with self._lock:
+            if not self.armed:
+                return None
+            self._ticks += 1
+            boosted = self.count_fn() - self._n0
+            if boosted < self.requests and self._ticks < self.max_ticks:
+                return None
+            tracer = self.metrics.tracer
+            tracer.configure(self._prev_sample)
+            wm1 = tracer.watermark
+            rec: Dict[str, Any] = {
+                "model": self.model, "reason": self._reason,
+                "requests_boosted": int(boosted),
+                "sample_boost": self.boost,
+                "trace_first": self._wm0 + 1 if wm1 > self._wm0 else 0,
+                "trace_last": wm1 if wm1 > self._wm0 else 0,
+                "n_windows": len(self._ring),
+                "windows": list(self._ring),
+            }
+            if self.stats_fn is not None:
+                rec["stats"] = self.stats_fn()
+            self.armed = False
+        self.metrics.counter_inc("serve_flights")
+        self.metrics.emit("serve_flight", **rec)
+        mlog.info(f"serve flight captured: {rec['requests_boosted']} "
+                  f"requests, traces {rec['trace_first']}.."
+                  f"{rec['trace_last']} ({self._reason})")
+        return rec
+
+
+class AdminServer:
+    """The four-surface admin endpoint over one ``ThreadingHTTPServer``
+    (daemon per-request threads, one acceptor thread named
+    ``cxxnet-serve-admin`` that ``close()`` joins)."""
+
+    def __init__(self, host, metrics, *, port: int,
+                 addr: str = "0.0.0.0",
+                 config: Optional[Dict[str, Any]] = None):
+        self.host = host
+        self.metrics = metrics
+        self._addr = (addr, int(port))
+        self._config = dict(config or {})
+        self._t0 = time.time()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # whole-object swaps the scrape path reads without locks
+        self._last_window: Dict[str, dict] = {}
+        self._footprints: Dict[str, dict] = {}
+        self.slo = None          # SloTracker (task_serve wires it)
+        self.flight: Optional[FlightCapture] = None
+
+    # ------------------------------------------------------------ wiring
+    def note_window(self, model: str, rec: dict) -> None:
+        """Reporter tick -> cached last window (atomic dict assignment;
+        /statusz reads it instead of draining window_stats(), which
+        belongs to the reporter and takes the batcher's window lock)."""
+        self._last_window = dict(self._last_window, **{model: dict(rec)})
+        if self.flight is not None:
+            self.flight.note_window(rec)
+
+    def note_ready(self) -> None:
+        """Cache each model's footprint at ready time — footprint()
+        walks executables and device buffers, too heavy for a 10 Hz
+        scrape path."""
+        try:
+            self._footprints = {name: self.host.model(name).footprint()
+                                for name in self.host.names}
+        except Exception as e:  # noqa: BLE001 — status must not gate ready
+            mlog.warn(f"admin: footprint cache failed: {e}")
+
+    # ------------------------------------------------------------- server
+    def start(self) -> int:
+        """Bind + serve; returns the bound port (``serve_admin_port``
+        echoes it, and port 0 in tests binds an ephemeral one)."""
+        admin = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no stderr per request
+                return
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    admin._route(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper went away mid-response
+
+        self._httpd = ThreadingHTTPServer(self._addr, _Handler)
+        self._httpd.daemon_threads = True
+
+        def _serve():
+            try:
+                self._httpd.serve_forever(poll_interval=0.1)
+            except Exception as e:  # noqa: BLE001 — thread contract:
+                # surface, never die silently (disclint thread rule)
+                mlog.warn(f"serve admin endpoint died: {e}")
+
+        self._thread = threading.Thread(target=_serve, daemon=True,
+                                        name="cxxnet-serve-admin")
+        self._thread.start()
+        mlog.info(f"serve admin endpoint on "
+                  f"http://{self._addr[0]}:{self.port}/  "
+                  "(/metrics /healthz /readyz /statusz)")
+        return self.port
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "call start() first"
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        """Stop accepting, join the acceptor.  Idempotent."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # ------------------------------------------------------------ routing
+    def _route(self, h: BaseHTTPRequestHandler) -> None:
+        path = h.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            body = self._metrics_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+            code = 200
+        elif path == "/healthz":
+            body, ctype, code = b"ok\n", "text/plain", 200
+        elif path == "/readyz":
+            ready = bool(self.host.ready)
+            body = b"ready\n" if ready else b"not ready\n"
+            ctype, code = "text/plain", (200 if ready else 503)
+        elif path in ("/statusz", "/"):
+            body = (json.dumps(self._statusz(), sort_keys=True,
+                               default=repr) + "\n").encode()
+            ctype, code = "application/json", 200
+        else:
+            body, ctype, code = b"not found\n", "text/plain", 404
+        h.send_response(code)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    # ------------------------------------------------------------ surfaces
+    def _exact_hists(self) -> Dict[str, Dict[int, int]]:
+        """Batch-size / occupancy distributions as exact ``le``-bucket
+        histograms (aggregated across models — one model per task run
+        today, and promtext keeps one family per name)."""
+        hists: Dict[str, Dict[int, int]] = {}
+        for name in self.host.names:
+            m = self.host.model(name)
+            bat = getattr(m, "batcher", None)
+            if bat is not None:
+                agg = hists.setdefault("serve_batch_hist", {})
+                for k, v in copy_racy(bat.batch_hist).items():
+                    agg[int(k)] = agg.get(int(k), 0) + int(v)
+            sched = getattr(m, "scheduler", None)
+            if sched is not None:
+                agg = hists.setdefault("decode_occupancy_hist", {})
+                for k, v in copy_racy(sched.occ_hist).items():
+                    agg[int(k)] = agg.get(int(k), 0) + int(v)
+        return hists
+
+    def _metrics_text(self) -> str:
+        snap = {"counters": copy_racy(self.metrics.counters),
+                "gauges": copy_racy(self.metrics.gauges),
+                "histograms": {k: h.summary() for k, h
+                               in copy_racy(
+                                   self.metrics.histograms).items()}}
+        return promtext.render(snap, hists=self._exact_hists())
+
+    def _model_status(self, name: str) -> Dict[str, Any]:
+        m = self.host.model(name)
+        out: Dict[str, Any] = {"retraces": int(m.retraces),
+                               "dtype": m.cfg.dtype}
+        win = self._last_window.get(name)
+        if win is not None:
+            out["last_window"] = win  # QPS / p99 over the last window
+        fp = self._footprints.get(name)
+        if fp:
+            out["footprint"] = fp
+        bat = getattr(m, "batcher", None)
+        if bat is not None:
+            # plain-int attrs + racy dict copies; NEVER bat._stats_lock
+            n_b = bat.n_batches
+            out.update(
+                kind="predict", requests=bat.n_requests, batches=n_b,
+                rows=bat.rows_served,
+                mean_batch=round(bat.rows_served / n_b, 2) if n_b
+                else 0.0,
+                batch_hist={str(k): v for k, v in sorted(
+                    copy_racy(bat.batch_hist).items())},
+                queue_depth_max=bat.depth_max)
+            eng_stats = getattr(m.engine, "stats", None)
+            if eng_stats is not None:
+                out["engine"] = eng_stats()
+        sched = getattr(m, "scheduler", None)
+        if sched is not None:
+            occ = copy_racy(sched.occ_hist)
+            tot = sum(occ.values())
+            out.update(
+                kind="generate", requests=sched.n_requests,
+                tokens=sched.n_tokens, steps=sched.n_steps,
+                prefills=sched.n_prefills,
+                mean_occupancy=round(sum(k * v for k, v in occ.items())
+                                     / tot, 2) if tot else 0.0,
+                occupancy_hist={str(k): v
+                                for k, v in sorted(occ.items())})
+            eng_stats = getattr(m.engine, "stats", None)
+            if eng_stats is not None:
+                out["engine"] = eng_stats()
+        return out
+
+    def _statusz(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "uptime_sec": round(time.time() - self._t0, 3),
+            "ready": bool(self.host.ready),
+            "models": {name: self._model_status(name)
+                       for name in self.host.names},
+            "config": self._config,
+            "flights": self.metrics.counters.get("serve_flights", 0),
+        }
+        slo = self.slo
+        if slo is not None:
+            out["slo"] = slo.verdict  # whole-object swap, no lock
+        return out

@@ -103,6 +103,12 @@ class MicroBatcher:
         self._win_lock = threading.Lock()
         self._win_lats: list = []
         self._win_requests = 0
+        # SLO arming (monitor/slo.py): when task_serve declares
+        # serve_slo_p99_ms, each windowed request over the threshold
+        # counts as one budget violation; window_stats() drains the
+        # count into the serve_window record's ``viol`` field
+        self.slo_ms = 0.0
+        self._win_viol = 0
 
     # ------------------------------------------------------------- client
     def start(self) -> None:
@@ -175,6 +181,8 @@ class MicroBatcher:
             with self._win_lock:
                 self._win_lats.append(latency)
                 self._win_requests += 1
+                if self.slo_ms > 0.0 and latency * 1e3 > self.slo_ms:
+                    self._win_viol += 1
         return req.result
 
     def _observe_depth(self, depth: int) -> None:
@@ -192,8 +200,11 @@ class MicroBatcher:
         with self._win_lock:
             lats, self._win_lats = self._win_lats, []
             n, self._win_requests = self._win_requests, 0
+            viol, self._win_viol = self._win_viol, 0
         out: Dict[str, Any] = {"requests": n,
                                "queue_depth": self._q.qsize()}
+        if self.slo_ms > 0.0:
+            out["viol"] = viol
         if lats:
             from ..monitor.metrics import nearest_rank
             lats.sort()

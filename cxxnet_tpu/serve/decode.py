@@ -166,6 +166,11 @@ class DecodeEngine:
         self._step_fn = None
         self._traces_at_warmup: Optional[int] = None
         self.warmup_sec = 0.0
+        # executable-call accounting for /statusz (serve/admin.py):
+        # dispatcher-thread writes, GIL-atomic reads, no lock
+        self.prefill_calls = 0
+        self.step_calls = 0
+        self.prompt_tokens = 0
 
     # ------------------------------------------------------------- build
     def _alloc_caches(self):
@@ -299,6 +304,16 @@ class DecodeEngine:
                 "buckets": 2,
                 "total_bytes": weight + opt + kv + temp + out + code}
 
+    def stats(self) -> Dict[str, object]:
+        """Executable-call accounting for /statusz: prefill/step call
+        counts, prompt-token volume, and the fixed cache geometry."""
+        return {"prefill_calls": self.prefill_calls,
+                "step_calls": self.step_calls,
+                "prompt_tokens": self.prompt_tokens,
+                "slots": self.slots, "max_seqlen": self.max_seqlen,
+                "kv_cache_bytes": self.kv_cache_bytes(),
+                "warmup_sec": round(self.warmup_sec, 3)}
+
     # ------------------------------------------------------------ decode
     def prefill(self, slot: int, tokens: np.ndarray) -> np.ndarray:
         """Fill ``slot``'s cache rows with ``tokens`` (a 1-D prompt, 1..
@@ -316,6 +331,8 @@ class DecodeEngine:
         if not 0 <= slot < self.slots:
             raise ValueError(f"prefill: slot {slot} out of "
                              f"0..{self.slots - 1}")
+        self.prefill_calls += 1
+        self.prompt_tokens += L
         ids = np.zeros((1, 1, 1, self.max_seqlen), np.float32)
         ids[0, 0, 0, :L] = tokens.astype(np.float32)
         logits, self._caches = self._prefill_fn(
@@ -334,6 +351,7 @@ class DecodeEngine:
         free slot's cache is fully overwritten by its next prefill)."""
         if self._traces_at_warmup is None:
             self.warmup()
+        self.step_calls += 1
         logits, self._caches = self._step_fn(
             self.trainer.params, self.trainer.buffers, self._caches,
             np.ascontiguousarray(tokens, np.int32),

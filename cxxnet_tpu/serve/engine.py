@@ -104,6 +104,13 @@ class PredictEngine:
         self._ref_fns: Dict[int, object] = {}
         self._traces_at_warmup: Optional[int] = None
         self.warmup_sec = 0.0
+        # dispatch accounting for /statusz (serve/admin.py): which
+        # bucket each dispatch landed in and how many pad rows it cost.
+        # Dispatcher-thread writes, GIL-atomic reads — no lock, and the
+        # admin scrape path copies racily (copy_racy)
+        self.bucket_hist: Dict[int, int] = {}
+        self.pad_rows = 0
+        self.dispatches = 0
 
     # ------------------------------------------------------------- params
     def _quant_keys(self) -> set:
@@ -275,6 +282,18 @@ class PredictEngine:
                 "buckets": len(self._fns),
                 "total_bytes": weight + opt + temp + out + code}
 
+    def stats(self) -> Dict[str, object]:
+        """Dispatch-side accounting for /statusz: bucket occupancy and
+        padding waste (pad_rows / (pad_rows + rows) is the fraction of
+        device rows burned on padding — the signal for re-declaring
+        ``serve_shapes``)."""
+        hist = dict(self.bucket_hist)
+        return {"dispatches": self.dispatches,
+                "bucket_hist": {str(k): v
+                                for k, v in sorted(hist.items())},
+                "pad_rows": self.pad_rows,
+                "warmup_sec": round(self.warmup_sec, 3)}
+
     # ------------------------------------------------------------ predict
     def bucket_for(self, n: int) -> int:
         """Smallest declared bucket holding ``n`` rows."""
@@ -309,6 +328,9 @@ class PredictEngine:
         while i < n:
             take = min(n - i, self.shapes[-1])
             b = self.bucket_for(take)
+            self.bucket_hist[b] = self.bucket_hist.get(b, 0) + 1
+            self.pad_rows += b - take
+            self.dispatches += 1
             t_pad0 = time.perf_counter() if tracing else 0.0
             chunk = x[i:i + take]
             if take < b:

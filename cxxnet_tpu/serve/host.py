@@ -137,10 +137,56 @@ class GenModel:
 
 
 class ModelHost:
-    """Concurrent multi-model routing over the shared device pool."""
+    """Concurrent multi-model routing over the shared device pool.
+
+    The host also carries the serving READY lifecycle and owns the
+    optional admin endpoint (serve/admin.py): ``ready`` is the
+    hot-swap admission signal (ROADMAP item 4) — False until
+    :meth:`mark_ready` verifies every hosted model warmed with its
+    executables pinned and ``retraces() == 0``, and False again from
+    the first line of :meth:`close`, BEFORE any batcher drains, so a
+    load balancer polling ``/readyz`` stops routing ahead of the
+    teardown."""
 
     def __init__(self):
         self._models: Dict[str, ServeModel] = {}
+        self._ready = False
+        self.admin = None       # AdminServer once start_admin() ran
+
+    # ----------------------------------------------------- ready lifecycle
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    def mark_ready(self) -> bool:
+        """Flip ready if (and only if) the admission contract holds:
+        at least one model, every engine warmed (executables pinned),
+        zero retraces.  Returns the new state; call after warmup (and
+        after calibration, which may retrace nothing but takes time a
+        health check should see as not-yet-ready)."""
+        warmed = bool(self._models) and all(
+            getattr(m.engine, "_traces_at_warmup", None) is not None
+            for m in self._models.values())
+        self._ready = warmed and self.retraces() == 0
+        if self._ready and self.admin is not None:
+            self.admin.note_ready()     # cache footprints for /statusz
+        elif warmed and not self._ready:
+            mlog.warn(f"host not ready: {self.retraces()} retraces "
+                      "after warmup (executables not pinned)")
+        return self._ready
+
+    def start_admin(self, metrics, *, port: int,
+                    config=None) -> "object":
+        """Start the admin endpoint (serve/admin.AdminServer) on
+        ``port`` (0 binds ephemeral); the host owns it — ``close()``
+        joins it LAST, so /healthz answers through the drain."""
+        from .admin import AdminServer
+        if self.admin is not None:
+            raise RuntimeError("admin endpoint already started")
+        self.admin = AdminServer(self, metrics, port=port,
+                                 config=config)
+        self.admin.start()
+        return self.admin
 
     def add(self, name: str, trainer, cfg: Optional[ServeConfig] = None,
             *, metrics=None, warmup: bool = True) -> ServeModel:
@@ -191,9 +237,13 @@ class ModelHost:
                                    for fp in per.values())}
 
     def close(self) -> None:
+        self._ready = False     # /readyz flips before any drain begins
         for m in self._models.values():
             m.close()
         self._models.clear()
+        if self.admin is not None:
+            self.admin.close()
+            self.admin = None
 
 
 def load_serve_model(pairs: Sequence[Tuple[str, str]], *,

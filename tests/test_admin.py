@@ -1,0 +1,550 @@
+"""Live serving control plane (serve/admin.py, monitor/promtext.py,
+monitor/slo.py — ISSUE 17, doc/serve.md "Operating a serve host").
+
+Covers the contracts the admin plane stands on: the Prometheus
+exposition is golden-stable (one mangling rule, one escaping rule,
+counters monotone across scrapes, exact ``le``-bucket histograms);
+``/readyz`` tracks the warmup->ready->draining lifecycle through the
+real CLI task; a 10 Hz scraper under client load neither perturbs
+request p99 past the normal A/B band (judged by the ONE comparison
+engine) nor leaks threads; SLO burn rates fire fast-before-slow on a
+spike and slow on a simmer; and a sentinel anomaly triggers exactly
+one boosted-trace flight capture whose ``serve_flight`` record lands
+in the sink.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.monitor import promtext
+from cxxnet_tpu.monitor.metrics import MetricsRegistry
+from cxxnet_tpu.monitor.sentinel import SentinelBank
+from cxxnet_tpu.monitor.slo import SloSpec, SloTracker
+from cxxnet_tpu.serve.admin import AdminServer, FlightCapture, copy_racy
+from cxxnet_tpu.serve.batcher import MicroBatcher
+
+from test_serve import trained_model  # noqa: F401 — registers fixture
+from test_serve import _serve_conf
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _admin_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("cxxnet-serve-admin")]
+
+
+# ------------------------------------------------------------ promtext
+
+def test_promtext_golden():
+    """The exposition text is a pure function of the snapshot — exact
+    output pinned, so a format drift breaks HERE, not on a scraper."""
+    snap = {
+        "counters": {"serve_flights": 2, "odd name/x": 1},
+        "gauges": {"serve_queue_depth": 3.0},
+        "histograms": {"serve_latency_sec": {
+            "count": 4, "sum": 0.01, "min": 0.001, "max": 0.004,
+            "mean": 0.0025, "last": 0.004,
+            "p50": 0.002, "p95": 0.004, "p99": 0.004}},
+    }
+    text = promtext.render(snap, labels={"model": 'a\\b"c\nd'},
+                           hists={"serve_batch_hist": {1: 2, 8: 3}})
+    lbl = 'model="a\\\\b\\"c\\nd"'
+    assert text == "\n".join([
+        '# TYPE cxxnet_odd_name_x_total counter',
+        'cxxnet_odd_name_x_total{%s} 1' % lbl,
+        '# TYPE cxxnet_serve_flights_total counter',
+        'cxxnet_serve_flights_total{%s} 2' % lbl,
+        '# TYPE cxxnet_serve_queue_depth gauge',
+        'cxxnet_serve_queue_depth{%s} 3' % lbl,
+        '# TYPE cxxnet_serve_latency_sec summary',
+        'cxxnet_serve_latency_sec{%s,quantile="0.5"} 0.002' % lbl,
+        'cxxnet_serve_latency_sec{%s,quantile="0.95"} 0.004' % lbl,
+        'cxxnet_serve_latency_sec{%s,quantile="0.99"} 0.004' % lbl,
+        'cxxnet_serve_latency_sec_sum{%s} 0.01' % lbl,
+        'cxxnet_serve_latency_sec_count{%s} 4' % lbl,
+        '# TYPE cxxnet_serve_batch_hist histogram',
+        'cxxnet_serve_batch_hist_bucket{le="1",%s} 2' % lbl,
+        'cxxnet_serve_batch_hist_bucket{le="8",%s} 5' % lbl,
+        'cxxnet_serve_batch_hist_bucket{le="+Inf",%s} 5' % lbl,
+        'cxxnet_serve_batch_hist_sum{%s} 26' % lbl,
+        'cxxnet_serve_batch_hist_count{%s} 5' % lbl,
+    ]) + "\n"
+    # and the module's own parser round-trips it, labels unescaped
+    fams = promtext.parse(text)
+    assert fams["cxxnet_serve_batch_hist"]["type"] == "histogram"
+    name, labels, v = fams["cxxnet_serve_flights_total"]["samples"][0]
+    assert labels["model"] == 'a\\b"c\nd' and v == 2
+
+
+def test_promtext_counter_monotonicity():
+    """Counters must be non-decreasing across scrapes — the property a
+    Prometheus ``rate()`` stands on."""
+    reg = MetricsRegistry()
+    reg.counter_inc("slo_burns", 3)
+    v1 = promtext.counter_values(promtext.parse(
+        promtext.render(reg.snapshot())))
+    reg.counter_inc("slo_burns", 2)
+    v2 = promtext.counter_values(promtext.parse(
+        promtext.render(reg.snapshot())))
+    for k, v in v1.items():
+        assert v2[k] >= v
+    assert v2["cxxnet_slo_burns_total"] == 5
+
+
+def test_promtext_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        promtext.parse("# TYPE cxxnet_x enum\ncxxnet_x 1\n")
+    with pytest.raises(ValueError):
+        promtext.parse("# TYPE cxxnet_x counter\ncxxnet_x one\n")
+    with pytest.raises(ValueError):  # counters may never go negative
+        promtext.parse("# TYPE cxxnet_x counter\ncxxnet_x_total -1\n")
+
+
+# ------------------------------------------------------- admin endpoints
+
+class _FakeEngine:
+    _traces_at_warmup = 2
+
+    def retraces(self):
+        return 0
+
+    def stats(self):
+        return {"dispatches": 5}
+
+
+class _FakeCfg:
+    dtype = "bf16"
+
+
+class _FakeModel:
+    def __init__(self, batcher=None):
+        self.name = "m"
+        self.cfg = _FakeCfg()
+        self.engine = _FakeEngine()
+        self.retraces = 0
+        if batcher is not None:
+            self.batcher = batcher
+
+    def footprint(self):
+        return {"total_bytes": 4096}
+
+
+class _FakeHost:
+    def __init__(self, model):
+        self._m = model
+        self.names = [model.name]
+        self.ready = False
+
+    def model(self, name):
+        return self._m
+
+
+class _FakeBatcherStats:
+    n_requests = 12
+    n_batches = 3
+    rows_served = 12
+    depth_max = 2
+    batch_hist = {4: 3}
+
+
+def test_admin_endpoints_lifecycle():
+    """/healthz live from bind; /readyz flips 503 -> 200 -> refused;
+    /statusz carries the per-model accounting; /metrics parses."""
+    host = _FakeHost(_FakeModel(_FakeBatcherStats()))
+    reg = MetricsRegistry()
+    reg.observe("serve_latency_sec", 0.002)
+    adm = AdminServer(host, reg, port=0, config={"serve_shapes": "1,8"})
+    try:
+        port = adm.start()
+        base = f"http://127.0.0.1:{port}"
+        assert _get(base + "/healthz") == (200, "ok\n")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/readyz")
+        assert ei.value.code == 503
+        host.ready = True
+        adm.note_ready()  # footprint cached at ready time
+        assert _get(base + "/readyz") == (200, "ready\n")
+        adm.note_window("m", {"qps": 50.0, "p99_ms": 3.0,
+                              "requests": 25, "queue_depth": 1})
+        st = json.loads(_get(base + "/statusz")[1])
+        assert st["ready"] is True and st["uptime_sec"] >= 0
+        assert st["config"]["serve_shapes"] == "1,8"
+        m = st["models"]["m"]
+        assert m["kind"] == "predict" and m["requests"] == 12
+        assert m["mean_batch"] == 4.0 and m["batch_hist"] == {"4": 3}
+        assert m["retraces"] == 0 and m["engine"]["dispatches"] == 5
+        assert m["last_window"]["p99_ms"] == 3.0
+        assert m["footprint"]["total_bytes"] == 4096
+        fams = promtext.parse(_get(base + "/metrics")[1])
+        assert "cxxnet_serve_latency_sec" in fams
+        assert fams["cxxnet_serve_batch_hist"]["type"] == "histogram"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+    finally:
+        adm.close()
+    time.sleep(0.1)
+    assert not _admin_threads()
+    # closed means refused, not hanging
+    with pytest.raises(OSError):
+        _get(f"http://127.0.0.1:{port}/healthz", timeout=0.5)
+
+
+def test_copy_racy_survives_concurrent_growth():
+    """The scrape path's lock-free dict copy: a dispatcher growing the
+    dict mid-copy must never propagate RuntimeError to the scraper."""
+    d = {i: i for i in range(64)}
+    stop = threading.Event()
+
+    def grow():
+        i = 64
+        while not stop.is_set():
+            d[i] = i
+            d.pop(i - 64, None)
+            i += 1
+
+    t = threading.Thread(target=grow, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            out = copy_racy(d)
+            assert isinstance(out, dict)
+    finally:
+        stop.set()
+        t.join()
+
+
+@pytest.mark.parametrize("attempt_budget", [3])
+def test_scrape_under_load_keeps_p99(attempt_budget):
+    """ISSUE 17 acceptance: a 10 Hz /metrics + /statusz scraper under
+    concurrent client load leaves request p99 inside the normal A/B
+    band — judged by the one comparison engine, generous CPU-CI band,
+    retried to absorb scheduler noise.  The scrape path takes no
+    dispatcher locks, so this holds by construction; the test pins it."""
+
+    def run_once(scrape):
+        reg = MetricsRegistry()
+        b = MicroBatcher(lambda x: x * 2.0, max_batch=8,
+                         max_wait_ms=1.0, metrics=reg, name="serve")
+        b.start()
+        adm = None
+        stop = threading.Event()
+        scrapers = []
+        try:
+            if scrape:
+                adm = AdminServer(_FakeHost(_FakeModel(b)), reg, port=0)
+                adm.start()
+                base = f"http://127.0.0.1:{adm.port}"
+
+                def scraper(path):
+                    while not stop.is_set():
+                        _get(base + path)
+                        stop.wait(0.1)  # 10 Hz
+
+                scrapers = [threading.Thread(target=scraper, args=(p,))
+                            for p in ("/metrics", "/statusz")]
+                for t in scrapers:
+                    t.start()
+
+            def client():
+                for _ in range(40):
+                    b.submit(np.ones((1, 4), np.float32))
+
+            ths = [threading.Thread(target=client) for _ in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+        finally:
+            stop.set()
+            for t in scrapers:
+                t.join()
+            if adm is not None:
+                adm.close()
+            b.close()
+        return reg.histograms["serve_latency_sec"].summary()["p99"] * 1e3
+
+    for attempt in range(attempt_budget):
+        from cxxnet_tpu.monitor.diff import LOWER_BETTER, compare
+        p99_off = run_once(scrape=False)
+        p99_on = run_once(scrape=True)
+        judge = compare("serve_p99_ms", a=p99_off, b=p99_on,
+                        rel=1.0, direction=LOWER_BETTER, abs_floor=2.0)
+        if not judge["regressed"]:
+            break
+    else:
+        pytest.fail(f"10 Hz scrape regressed p99 in every attempt: "
+                    f"{judge}")
+    time.sleep(0.1)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxxnet-serve")]
+
+
+# ------------------------------------------------------------------ SLO
+
+def _win(requests, viol):
+    return {"requests": requests, "viol": viol}
+
+
+def test_slo_burn_math_and_fast_before_slow():
+    """burn == (viol/requests) / (1 - avail); an acute spike fires the
+    fast tier while the slow window still averages it away."""
+    spec = SloSpec(p99_ms=10.0, avail=0.99, fast_sec=2.0, slow_sec=10.0,
+                   fast_burn=5.0, slow_burn=2.0)
+    trk = SloTracker(spec, window_sec=1.0)
+    for _ in range(9):
+        assert trk.observe(_win(100, 0)) is None
+    fired = trk.observe(_win(100, 20))  # fast ring = 2 windows
+    assert fired is not None and fired["tier"] == "fast"
+    # fast burn: 20/200 err over budget 0.01 -> 10.0 >= 5.0
+    assert fired["burn"] == pytest.approx(10.0)
+    assert fired["requests"] == 200 and fired["viol"] == 20
+    v = trk.verdict
+    assert v["fast"]["firing"] and not v["slow"]["firing"]
+    # slow burn: 20/1000 / 0.01 = 2.0 — at threshold, NOT over it
+    assert v["slow"]["burn"] == pytest.approx(2.0)
+    assert not v["ok"]
+
+
+def test_slo_slow_tier_catches_sustained_burn():
+    """A simmering violation rate under the fast threshold still fires
+    the slow tier once the long window fills — and the record is
+    emitted on the rising edge only (no re-fire while latched)."""
+    reg = MetricsRegistry()
+    spec = SloSpec(p99_ms=10.0, avail=0.99, fast_sec=2.0, slow_sec=6.0,
+                   fast_burn=50.0, slow_burn=2.0)
+    trk = SloTracker(spec, window_sec=1.0, metrics=reg, model="m")
+    fires = [trk.observe(_win(100, 3)) for _ in range(12)]
+    fired = [f for f in fires if f]
+    assert len(fired) == 1 and fired[0]["tier"] == "slow"
+    assert fired[0]["burn"] == pytest.approx(3.0)
+    assert reg.counters["slo_burns"] == 1
+    # burn clears -> tier unlatches -> a new excursion fires again
+    for _ in range(12):
+        trk.observe(_win(100, 0))
+    assert trk.verdict["ok"]
+    assert any(trk.observe(_win(100, 3)) for _ in range(12))
+
+
+def test_slo_inactive_without_target():
+    trk = SloTracker(SloSpec(p99_ms=0.0), window_sec=1.0)
+    assert trk.observe(_win(100, 100)) is None
+    assert trk.verdict["active"] is False
+    with pytest.raises(ValueError):
+        SloSpec(p99_ms=5.0, avail=1.0)  # zero budget has no burn rate
+
+
+# -------------------------------------------------------- flight capture
+
+def test_sentinel_anomaly_triggers_flight(tmp_path):
+    """Serve-sentinel e2e: a p99 regression fires an anomaly, the
+    on_anomaly hook arms the flight capture, the capture boosts
+    trace_sample for K requests and lands ONE serve_flight record with
+    the window ring and the boosted trace-id range."""
+    sink = tmp_path / "m.jsonl"
+    reg = MetricsRegistry()
+    reg.configure_sink(f"jsonl:{sink}")
+    served = [0]
+    flight = FlightCapture(reg, lambda: served[0], model="m", boost=1,
+                           requests=4, ring=4,
+                           stats_fn=lambda: {"depth_max": 1})
+    bank = SentinelBank(reg, rel=0.2, warmup=3, ring=8,
+                        on_anomaly=lambda hit: flight.trigger(
+                            f"anomaly: {hit['metric']} {hit['direction']}"))
+    base = {"model": "m", "qps": 100.0, "queue_depth": 0,
+            "requests": 50}
+    for i in range(5):
+        rec = dict(base, window=i + 1, p99_ms=5.0)
+        flight.note_window(rec)
+        bank.observe_serve(rec)
+        assert flight.tick() is None  # nothing armed yet
+    spike = dict(base, window=6, p99_ms=50.0)
+    flight.note_window(spike)
+    bank.observe_serve(spike)
+    assert flight.armed
+    assert not flight.trigger("second anomaly")  # one flight per storm
+    # boosted requests arrive, each drawing a trace id
+    for _ in range(4):
+        served[0] += 1
+        reg.tracer.new_trace()
+    rec = flight.tick()
+    assert rec is not None and not flight.armed
+    assert rec["requests_boosted"] >= 4
+    assert rec["trace_last"] >= rec["trace_first"] >= 1
+    assert rec["n_windows"] == 4  # ring depth, NOT cleared by the dump
+    assert rec["stats"] == {"depth_max": 1}
+    assert reg.tracer.sample == 0  # sampling restored
+    reg.sink.close()
+    kinds = [json.loads(l)["kind"] for l in open(sink)]
+    assert kinds.count("anomaly") >= 1
+    assert kinds.count("serve_flight") == 1
+    assert kinds.index("flight") < kinds.index("serve_flight")
+
+
+def test_flight_capture_completes_on_dead_air():
+    """No traffic after the trigger: max_ticks bounds the capture so
+    the record still lands (with zero boosted requests)."""
+    reg = MetricsRegistry()
+    flight = FlightCapture(reg, lambda: 0, requests=8, max_ticks=3)
+    assert flight.trigger("slo: fast burn")
+    recs = [flight.tick() for _ in range(3)]
+    assert recs[:2] == [None, None] and recs[2] is not None
+    assert recs[2]["requests_boosted"] == 0
+    assert recs[2]["trace_first"] == recs[2]["trace_last"] == 0
+
+
+# --------------------------------------------------------------- CLI e2e
+
+def test_cli_admin_readyz_lifecycle(trained_model):  # noqa: F811
+    """ISSUE 17 acceptance, through the real CLI: /readyz answers 503
+    while the host is still compiling, 200 once warmup pinned the
+    executables, refused after close — and the serve record still says
+    zero retraces with the admin plane scraping."""
+    from cxxnet_tpu.main import LearnTask
+    tmp_path, net, model = trained_model
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    conf = _serve_conf(
+        tmp_path, net, model,
+        extra=f"serve_admin_port = {port}\nserve_sentinel = 1\n"
+              "serve_sentinel_window = 0.05\nserve_slo_p99_ms = 250\n")
+    base = f"http://127.0.0.1:{port}"
+    seen, got = [], {}
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            try:
+                code, _ = _get(base + "/readyz", timeout=0.5)
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except OSError:
+                code = None  # not bound yet / already closed
+            if code is not None and (not seen or seen[-1] != code):
+                seen.append(code)
+            if code == 200:
+                # keep the LAST ready scrape — the first ready tick may
+                # precede the first served request's latency sample,
+                # and a scrape during the close drain reads ready=False
+                try:
+                    st = json.loads(_get(base + "/statusz")[1])
+                    if st.get("ready"):
+                        got["statusz"] = st
+                        got["metrics"] = promtext.parse(
+                            _get(base + "/metrics")[1])
+                except OSError:
+                    pass  # host closed between the polls
+            stop.wait(0.01)
+
+    poller = threading.Thread(target=poll)
+    poller.start()
+    try:
+        assert LearnTask().run([str(conf)]) == 0
+    finally:
+        stop.set()
+        poller.join()
+    # lifecycle: not-ready strictly before ready (warmup gate)
+    assert 503 in seen and 200 in seen, seen
+    assert seen.index(503) < seen.index(200)
+    # the endpoint died with the host
+    with pytest.raises(OSError):
+        _get(base + "/healthz", timeout=0.5)
+    st = got["statusz"]
+    assert st["ready"] is True
+    assert st["models"]["default"]["retraces"] == 0
+    assert st["slo"]["active"] and st["slo"]["p99_ms_target"] == 250.0
+    assert "cxxnet_serve_latency_sec" in got["metrics"]
+    # zero retraces with the admin plane on — from the run's own record
+    recs = [json.loads(l)
+            for l in open(tmp_path / "serve_metrics.jsonl")]
+    srv = [r for r in recs if r["kind"] == "serve"]
+    assert srv and srv[-1]["retraces"] == 0
+    wins = [r for r in recs if r["kind"] == "serve_window"]
+    assert wins and all("viol" in w for w in wins)  # SLO-armed batcher
+    time.sleep(0.1)
+    assert not _admin_threads()
+
+
+# ------------------------------------------------------- obsv --live
+
+def test_obsv_live_renders_serving_tables():
+    """tools/obsv.py --live maps one /statusz + /metrics scrape into
+    the same report shapes the JSONL path builds."""
+    host = _FakeHost(_FakeModel(_FakeBatcherStats()))
+    host.ready = True
+    reg = MetricsRegistry()
+    reg.counter_inc("serve_flights")
+    for v in (0.001, 0.002, 0.004):
+        reg.observe("serve_latency_sec", v)
+    adm = AdminServer(host, reg, port=0)
+    try:
+        adm.start()
+        adm.note_ready()
+        adm.note_window("m", {"qps": 80.0, "p99_ms": 4.0,
+                              "requests": 40, "queue_depth": 1})
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "tools"))
+        import obsv
+        rep = obsv.live_report(f"127.0.0.1:{adm.port}")
+    finally:
+        adm.close()
+    assert rep["live"]["ready"] is True and rep["live"]["flights"] == 1
+    assert rep["serving"][0]["model"] == "m"
+    assert rep["serving"][0]["requests"] == 12
+    assert rep["serve_windows"]["p99_ms_max"] == 4.0
+    assert rep["latency"][0]["count"] == 3
+    assert rep["latency"][0]["p99"] == pytest.approx(4.0)
+    text = obsv.render(rep)
+    assert "live:" in text and "serving: 1 run(s)" in text
+
+
+# ------------------------------------------------------------- conflint
+
+def _lint(text):
+    from cxxnet_tpu.analysis.conflint import lint_pairs
+    from cxxnet_tpu.utils.config import parse_config_string
+    return lint_pairs(parse_config_string(text))
+
+
+def test_conflint_slo_rules():
+    base = "task = serve\nserve_sentinel = 1\nmetrics_sink = jsonl:m\n"
+    # burn windows must be whole multiples of the reporter window
+    f = _lint(base + "serve_sentinel_window = 0.3\n"
+                     "serve_slo_p99_ms = 10\nserve_slo_fast_sec = 1\n")
+    assert any(x.severity == "error" and "serve_slo_fast_sec" == x.key
+               for x in f)
+    # SLO without the sentinel reporter: no window stream to judge
+    f = _lint("task = serve\nserve_slo_p99_ms = 10\n")
+    assert any(x.severity == "warn" and x.key == "serve_slo_p99_ms"
+               for x in f)
+    # flight knobs without a sentinel: nothing can ever trigger
+    f = _lint("task = serve\nserve_flight_requests = 8\n")
+    assert any(x.severity == "warn" and x.key == "serve_flight_requests"
+               for x in f)
+    # fast window >= slow window defeats the two-tier split
+    f = _lint(base + "serve_slo_p99_ms = 10\nserve_slo_fast_sec = 600\n"
+                     "serve_slo_slow_sec = 60\n")
+    assert any(x.severity == "warn" and "fast" in x.message.lower()
+               for x in f)
+    # off-task serve keys warn; the KeySpec range bounds the port
+    f = _lint("task = train\nserve_admin_port = 9100\n")
+    assert any(x.severity == "warn" for x in f)
+    f = _lint("task = serve\nserve_admin_port = 70000\n")
+    assert any(x.severity == "warn" and x.key == "serve_admin_port"
+               and "65535" in x.message for x in f)

@@ -18,7 +18,12 @@
 #      span record or tools/spans2trace.py fails the gate the same way;
 #   6. the cross-run comparator self-diffed over the fixture — a run
 #      must never regress against itself (exit 0, zero regressions), so
-#      drift in the diff engine or the ledger fold fails here.
+#      drift in the diff engine or the ledger fold fails here;
+#   7. the Prometheus exposition round-trip — render a synthetic
+#      registry snapshot (counters + summaries + an exact histogram)
+#      through monitor/promtext.py and parse it back with the same
+#      module's grammar-checking parser; a drift between what /metrics
+#      emits and what scrapers accept fails here, not on a live host.
 # Companion to tools/tier1.sh (the runtime gate); see doc/check.md.
 cd "$(dirname "$0")/.." || exit 1
 set -e
@@ -35,4 +40,19 @@ env JAX_PLATFORMS=cpu python tools/obsv.py --diff \
     tests/fixtures/run_report.jsonl tests/fixtures/run_report.jsonl \
     --json | python -c \
     'import json,sys; d=json.load(sys.stdin); assert d["regressions"] == 0'
+env JAX_PLATFORMS=cpu python -c '
+from cxxnet_tpu.monitor import promtext
+snap = {"counters": {"serve_requests": 42, "serve/odd name": 1},
+        "gauges": {"queue_depth": 3},
+        "histograms": {"serve_latency_sec": {
+            "count": 3, "sum": 0.008, "min": 0.001, "max": 0.005,
+            "mean": 0.00267, "last": 0.005,
+            "p50": 0.002, "p95": 0.005, "p99": 0.005}}}
+text = promtext.render(snap, hists={"serve_batch_hist": {8: 6, 4: 2}})
+fams = promtext.parse(text)
+assert promtext.counter_values(fams)["cxxnet_serve_requests_total"] == 42
+assert fams["cxxnet_serve_batch_hist"]["type"] == "histogram"
+tabs = promtext.live_tables(fams)
+assert tabs["counters"]["serve_requests"] == 42
+assert tabs["summaries"]["serve_latency_sec"]["p99"] == 0.005'
 echo "lint OK"

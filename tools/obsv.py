@@ -14,6 +14,7 @@ as aligned terminal tables or one ``--json`` object for CI.
     python tools/obsv.py metrics.jsonl --trace /tmp/prof   # re-attribute
     python tools/obsv.py --diff A.jsonl B.jsonl            # CI gate
     python tools/obsv.py metrics.jsonl --follow            # live tail
+    python tools/obsv.py --live host:9100                  # scrape once
 
 ``--diff`` aligns two runs through the one comparison engine
 (cxxnet_tpu/monitor/diff.py) and **exits 1 on any regression** past
@@ -230,6 +231,22 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
              ("metric", "direction", "value", "ewma", "rel_dev",
               "round", "step", "window") if k in r}
             for r in by["anomaly"]]
+    if by.get("slo"):
+        # SLO burn-rate alerts from the serving control plane
+        # (doc/monitor.md "slo" record): one row per rising edge
+        rep["slo"] = [
+            {k: r.get(k) for k in
+             ("model", "tier", "burn", "threshold", "budget",
+              "error_rate", "requests", "viol", "window_sec") if k in r}
+            for r in by["slo"]]
+    if by.get("serve_flight"):
+        # anomaly/SLO-triggered flight captures (doc/monitor.md
+        # "serve_flight" record): boosted-trace windows around a fire
+        rep["serve_flights"] = [
+            {k: r.get(k) for k in
+             ("model", "reason", "requests_boosted", "sample_boost",
+              "trace_first", "trace_last", "n_windows") if k in r}
+            for r in by["serve_flight"]]
     rep["flights"] = len(by.get("flight", []))
     if by.get("nan"):
         rep["nonfinite_steps"] = len(by["nan"])
@@ -268,6 +285,26 @@ def render(rep: dict) -> str:
     run = rep.get("run")
     if run:
         out.append("run: " + "  ".join(f"{k}={v}" for k, v in run.items()))
+    live = rep.get("live")
+    if live:
+        out.append(f"live: {live['url']}  "
+                   f"ready={live.get('ready')}  "
+                   f"uptime={_fmt(live.get('uptime_sec'), 1)}s  "
+                   f"flights={live.get('flights', 0)}")
+        sv = live.get("slo")
+        if sv and sv.get("active"):
+            rows = []
+            for tier in ("fast", "slow"):
+                t = sv.get(tier) or {}
+                rows.append([tier, _fmt(t.get("burn")),
+                             _fmt(t.get("threshold")),
+                             _fmt(t.get("window_sec")),
+                             "FIRING" if t.get("firing") else "ok"])
+            out.append(f"slo: p99<={_fmt(sv.get('p99_ms_target'))}ms "
+                       f"avail>={_fmt(sv.get('avail_target'), 4)} "
+                       f"({'ok' if sv.get('ok') else 'BURNING'})")
+            out.append(_table(
+                ["tier", "burn", "threshold", "win_s", "state"], rows))
     th = rep.get("throughput")
     if th:
         out.append(
@@ -512,6 +549,30 @@ def render(rep: dict) -> str:
     elif rep.get("kinds", {}).get("step"):
         out.append("")
         out.append("anomalies: none")
+    slo = rep.get("slo")
+    if slo:
+        out.append("")
+        out.append(f"SLO BURNS: {len(slo)}")
+        out.append(_table(
+            ["model", "tier", "burn", "threshold", "err_rate",
+             "requests", "viol", "win_s"],
+            [[str(r.get("model", "?")), str(r.get("tier", "?")),
+              _fmt(r.get("burn")), _fmt(r.get("threshold")),
+              _fmt(r.get("error_rate"), 4), _fmt(r.get("requests")),
+              _fmt(r.get("viol")), _fmt(r.get("window_sec"))]
+             for r in slo]))
+    sfl = rep.get("serve_flights")
+    if sfl:
+        out.append("")
+        out.append(f"SERVE FLIGHTS: {len(sfl)}")
+        out.append(_table(
+            ["model", "reason", "boosted", "sample", "traces", "wins"],
+            [[str(r.get("model", "?")),
+              str(r.get("reason", "?"))[:48],
+              _fmt(r.get("requests_boosted")),
+              _fmt(r.get("sample_boost")),
+              f"{r.get('trace_first', 0)}..{r.get('trace_last', 0)}",
+              _fmt(r.get("n_windows"))] for r in sfl]))
     if rep.get("nonfinite_steps"):
         out.append(f"NON-FINITE LOSS steps: {rep['nonfinite_steps']}")
     return "\n".join(out)
@@ -543,7 +604,8 @@ class Follower:
     two polls parses once, whole.  Alerts are the record kinds an
     operator wants flagged the moment they land."""
 
-    ALERT_KINDS = ("anomaly", "flight", "nan", "rollback")
+    ALERT_KINDS = ("anomaly", "flight", "nan", "rollback", "slo",
+                   "serve_flight")
 
     def __init__(self, path: str):
         self.path = path
@@ -592,6 +654,15 @@ def _alert_line(r: dict) -> str:
     elif k == "rollback":
         body = (f"retry {r.get('retry')}/{r.get('max_retry')}: restored "
                 f"round {r.get('restored_round')} ({r.get('reason')})")
+    elif k == "slo":
+        body = (f"{r.get('model')} {r.get('tier')} burn "
+                f"{_fmt(r.get('burn'))} >= {_fmt(r.get('threshold'))} "
+                f"({r.get('viol')}/{r.get('requests')} over "
+                f"{_fmt(r.get('window_sec'))}s)")
+    elif k == "serve_flight":
+        body = (f"{r.get('model')}: traces "
+                f"{r.get('trace_first')}..{r.get('trace_last')} captured "
+                f"({r.get('reason')})")
     else:
         body = json.dumps({k2: v for k2, v in r.items() if k2 != "ts"})
     return f"!! {k}: {body}"
@@ -676,6 +747,84 @@ def run_diff(path_a: str, path_b: str, rel: float,
     return 1 if d["regressions"] else 0
 
 
+def live_report(url: str, top: int = 10) -> dict:
+    """One-shot scrape of a live serve host's admin endpoint
+    (doc/serve.md "Operating a serve host"): fetch ``/statusz`` +
+    ``/metrics`` once and map them into the same report shapes the
+    JSONL path builds, so ``render()`` produces the familiar tables.
+
+    Stdlib-only on the wire (urllib) and lazy on the parse import —
+    pointing obsv at a remote host must not drag jax in.
+    """
+    import urllib.request
+
+    from cxxnet_tpu.monitor import promtext
+
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    with urllib.request.urlopen(base + "/statusz", timeout=5) as r:
+        status = json.loads(r.read().decode("utf-8"))
+    with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+        text = r.read().decode("utf-8")
+    tables = promtext.live_tables(promtext.parse(text))
+
+    rep: dict = {"live": {
+        "url": base,
+        "ready": status.get("ready"),
+        "uptime_sec": status.get("uptime_sec"),
+        "flights": status.get("flights", 0),
+        "slo": status.get("slo"),
+        "counters": tables["counters"],
+        "gauges": tables["gauges"],
+    }}
+    serving, generation, wins = [], [], []
+    for name, st in sorted((status.get("models") or {}).items()):
+        row = {"model": name, "retraces": st.get("retraces"),
+               "dtype": st.get("dtype")}
+        if isinstance(st.get("footprint"), dict):
+            row["footprint"] = st["footprint"]
+        if st.get("kind") == "generate":
+            row.update({k: st.get(k) for k in
+                        ("requests", "tokens", "steps", "prefills",
+                         "mean_occupancy", "occupancy_hist")
+                        if k in st})
+            generation.append(row)
+        else:
+            row.update({k: st.get(k) for k in
+                        ("requests", "batches", "mean_batch",
+                         "batch_hist", "queue_depth_max") if k in st})
+            serving.append(row)
+        if st.get("last_window"):
+            wins.append(st["last_window"])
+    if serving:
+        rep["serving"] = serving
+    if generation:
+        rep["generation"] = generation
+    if wins:
+        qps = [w["qps"] for w in wins if w.get("qps") is not None]
+        p99 = [w["p99_ms"] for w in wins if w.get("p99_ms") is not None]
+        rep["serve_windows"] = {
+            "windows": len(wins),
+            "qps_min": min(qps) if qps else None,
+            "qps_max": max(qps) if qps else None,
+            "p99_ms_max": max(p99) if p99 else None,
+            "queue_depth_max": max((w.get("queue_depth") or 0
+                                    for w in wins), default=0),
+        }
+    # request-latency summary back in the ms unit the JSONL tables use
+    lat = tables["summaries"].get("serve_latency_sec")
+    if lat and lat.get("count"):
+        rep["latency"] = [{
+            "op": "serve_latency", "count": int(lat["count"]),
+            "mean": round(lat["sum"] / lat["count"] * 1e3, 3),
+            "p50": round(lat.get("p50", 0.0) * 1e3, 3),
+            "p95": round(lat.get("p95", 0.0) * 1e3, 3),
+            "p99": round(lat.get("p99", 0.0) * 1e3, 3),
+            "unit": "ms"}]
+    return rep
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run report / cross-run diff / live follow over "
@@ -707,12 +856,28 @@ def main(argv=None) -> int:
     ap.add_argument("--follow-ticks", type=int, default=0,
                     help="--follow: stop after N polls (0 = until the "
                     "ledger record or Ctrl-C; CI smoke uses a bound)")
+    ap.add_argument("--live", default="", metavar="URL",
+                    help="scrape a live serve host's admin endpoint "
+                    "(host:port or http://host:port) once — /statusz + "
+                    "/metrics — and render the same serving tables")
     args = ap.parse_args(argv)
     if args.diff:
         return run_diff(args.diff[0], args.diff[1], rel=args.rel,
                         as_json=args.json)
+    if args.live:
+        try:
+            rep = live_report(args.live, top=args.top)
+        except OSError as e:
+            print(f"obsv: live: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            print(render(rep))
+        return 0
     if not args.jsonl:
-        ap.error("a metrics JSONL is required (or use --diff A B)")
+        ap.error("a metrics JSONL is required (or use --diff A B, "
+                 "or --live URL)")
     if args.follow:
         return follow(args.jsonl, interval=args.interval, top=args.top,
                       ticks=args.follow_ticks)
