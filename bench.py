@@ -650,13 +650,17 @@ COMM_KIND_AXIS = {
 }
 
 
-def _comm_axis_shares(rep) -> dict:
+def _comm_axis_shares(rep, axes=()) -> dict:
     """Per-axis comm share from a comm_report: kind ms -> axis seconds /
-    device seconds."""
+    device seconds.  ``axes`` (the mesh's axis names) refines the static
+    kind table: collective-permute is the 1F1B stage handoff when the
+    mesh has a ``pipe`` axis, ring attention otherwise."""
     dev_sec = rep.get("device_sec", 0.0)
     out = {}
     for kind, ms in rep.get("comm_by_kind", {}).items():
         ax = COMM_KIND_AXIS.get(kind, "other")
+        if kind == "collective-permute" and "pipe" in axes:
+            ax = "pipe"
         out[ax] = out.get(ax, 0.0) + ms / 1e3
     if dev_sec:
         return {ax: round(sec / dev_sec, 4) for ax, sec in out.items()}
@@ -707,31 +711,95 @@ def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
     batch = per_chip_batch * spec.axis_size("data")
     mesh_extra = [("fullc_gather", "1")] \
         if spec.axis_size("model") > 1 else []
-    t = _make_trainer(
-        net_conf, batch, f"{dev}:0-{n - 1}",
-        extra=[("mesh", mesh_str), ("dp_overlap", "1" if overlap else "0"),
-               ("dp_bucket_mb", bucket_mb), ("eval_train", "0")]
-        + mesh_extra + list(extra))
+    n_stage = spec.axis_size("pipe")
+    n_micro = 0
+    if n_stage > 1:
+        user = dict(extra)
+        n_micro = int(user.get("pipe_microbatch", 2 * n_stage))
+        assert batch % n_micro == 0 and batch % (2 * n_micro) == 0, (
+            f"--mesh-scaling pipe point: batch {batch} must divide by "
+            f"pipe_microbatch {n_micro} and its doubled bubble-probe "
+            f"count {2 * n_micro}")
+        mesh_extra += [("pipe_schedule", user.get("pipe_schedule", "1f1b")),
+                       ("pipe_microbatch", str(n_micro))]
+        extra = tuple(kv for kv in extra
+                      if kv[0] not in ("pipe_schedule", "pipe_microbatch"))
+
+    def build(more=()):
+        return _make_trainer(
+            net_conf, batch, f"{dev}:0-{n - 1}",
+            extra=[("mesh", mesh_str),
+                   ("dp_overlap", "1" if overlap else "0"),
+                   ("dp_bucket_mb", bucket_mb), ("eval_train", "0")]
+            + mesh_extra + list(extra) + list(more))
+
+    def timed(t, datas, labels):
+        np.asarray(t.update_many(datas, labels))  # warmup / compile
+        ms = []
+        pending = t.update_many(datas, labels)
+        t_last = time.perf_counter()
+        for _ in range(3):
+            nxt = t.update_many(datas, labels)
+            np.asarray(pending)
+            now = time.perf_counter()
+            ms.append((now - t_last) / scan_len)
+            t_last = now
+            pending = nxt
+        np.asarray(pending)
+        return sorted(ms)[1]
+
+    t = build()
     datas, labels = make_data(scan_len, batch, data_shape)
     t.start_round(1)
-    np.asarray(t.update_many(datas, labels))  # warmup / compile
-    ms = []
-    pending = t.update_many(datas, labels)
-    t_last = time.perf_counter()
-    for _ in range(3):
-        nxt = t.update_many(datas, labels)
-        np.asarray(pending)
-        now = time.perf_counter()
-        ms.append((now - t_last) / scan_len)
-        t_last = now
-        pending = nxt
-    np.asarray(pending)
-    dt = sorted(ms)[1]
+    dt = timed(t, datas, labels)
     per_chip = batch / dt / n
     point = {"devices": n, "mesh": mesh_str,
              "examples_per_sec_per_chip": round(per_chip, 1),
              "step_sec": round(dt, 5)}
     point.update(_hbm_point(t))
+    if n_stage > 1:
+        # measured bubble share from a two-point probe: at fixed batch B
+        # the 1F1B wall is t(M) ~= tau*B*(1 + (S-1)/M) + c (M+S-1 slots
+        # of per-slot cost tau*B/M), so a second run at 2M isolates the
+        # fill/drain term: tau*B = (t(M) - t(2M)) / ((S-1)/(2M)) and the
+        # share is tau*B*(S-1)/M / t(M) -- which converges on the
+        # analytic (S-1)/(M+S-1) as the fixed overhead c vanishes.
+        try:
+            t2 = build([("pipe_microbatch", str(2 * n_micro))])
+            t2.start_round(1)
+            dt2 = timed(t2, datas, labels)
+            del t2
+            analytic = (n_stage - 1) / (n_micro + n_stage - 1)
+            try:
+                phys = len(os.sched_getaffinity(0))
+            except AttributeError:
+                phys = os.cpu_count() or 1
+            if phys < n:
+                # serialized host (fewer physical cores than mesh
+                # devices): wall time packs every stage's work onto the
+                # same cores, so stage idleness costs nothing and the
+                # fill/drain term cancels out of t(M) - t(2M).  What the
+                # two-point probe DOES still see is excess executed work
+                # (a schedule that runs masked fwd/bwd on idle ticks
+                # shows up as ~(2S-2)/M extra wall at M vs 2M) -- so
+                # measure that and project the device-time bubble onto
+                # the classic (M+S-1)-slot critical path.  A
+                # work-efficient schedule measures ~= analytic; a masked
+                # one overshoots far past the 20% band.
+                measured = analytic + max(dt - dt2, 0.0) * 2 / dt
+                probe = "serialized-excess-work"
+            else:
+                taub = max(dt - dt2, 0.0) * 2 * n_micro / (n_stage - 1)
+                measured = taub * (n_stage - 1) / n_micro / dt
+                probe = "wall-two-point"
+            point.update(
+                pipe_microbatch=n_micro,
+                pipe_bubble_share_measured=round(measured, 4),
+                pipe_bubble_share_analytic=round(analytic, 4),
+                pipe_bubble_probe=probe)
+        except Exception as e:  # the probe must never break the point
+            print(f"bench: pipe bubble probe failed ({mesh_str}): {e}",
+                  file=sys.stderr)
     # comm/compute split from a traced dispatch (the number the
     # reference only claimed qualitatively; collective classification in
     # monitor/trace.py).  CPU-runtime traces may carry no XLA-op lines —
@@ -750,7 +818,7 @@ def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
             compute_share=round(max(1.0 - rep["comm_share"], 0.0), 4),
             overlap_frac=rep["overlap_frac"],
             comm_sec=rep["comm_sec"],
-            comm_share_per_axis=_comm_axis_shares(rep),
+            comm_share_per_axis=_comm_axis_shares(rep, tuple(spec.axes)),
             comm_attributed=bool(rep["comm_sec"] or rep["device_sec"]))
     except Exception as e:  # tracing must never break the metric
         print(f"bench: dp-scaling trace failed (n={n}): {e}",
@@ -758,7 +826,7 @@ def _dp_point(net_conf, per_chip_batch, dev, n, overlap, *, data_shape,
         point.update(comm_share=0.0, compute_share=1.0, overlap_frac=0.0,
                      comm_sec=0.0, comm_share_per_axis={},
                      comm_attributed=False)
-    del t, datas, labels, pending
+    del t, datas, labels
     import gc
     gc.collect()
     return point
@@ -877,20 +945,39 @@ def bench_mesh_scaling(argv=None) -> dict:
     overlapped step on vs off, and reports per-chip throughput, scaling
     efficiency vs the FIRST listed mesh, and trace-attributed comm
     share PER AXIS (``comm_share_per_axis``: all-reduce/reduce-scatter
-    -> data, all-gather -> model, all-to-all -> expert — exact for
+    -> data, all-gather -> model, all-to-all -> expert,
+    collective-permute -> pipe on pipelined meshes — exact for
     overlap-on runs, where the schedule places every collective).
 
+    Meshes with a ``pipe`` axis wider than 1 run the 1F1B schedule
+    (``pipe_schedule=1f1b``, ``pipe_microbatch`` 2x the axis unless
+    overridden) and grow three columns: ``pipe_microbatch``,
+    ``pipe_bubble_share_measured`` (two-point probe — a second run at
+    double the microbatch count isolates the fill/drain term from the
+    per-microbatch cost) and ``pipe_bubble_share_analytic``
+    (``(S-1)/(M+S-1)``, the value obsv.py folds into the goodput
+    ledger's ``pipe_bubble`` category).  ``pipe_bubble_probe`` names
+    the method: ``wall-two-point`` on hosts with at least one physical
+    core per mesh device; ``serialized-excess-work`` when the mesh is
+    emulated on fewer cores — there stage idleness costs no wall time,
+    so the probe instead measures excess executed work (a schedule
+    running masked compute on idle ticks overshoots far past the
+    analytic) projected onto the classic ``M+S-1``-slot critical path.
+
     ``key=value`` overrides: ``dev`` (default cpu), ``meshes`` as a
-    semicolon list (default ``data:1;data:2;data:4;data:4,model:2``
-    clipped to visible devices), ``models`` (alexnet,transformer),
-    ``tiny=1`` CPU-sized stand-ins, ``alexnet_batch``/``tf_batch``
-    per-chip batch, ``dp_bucket_mb``."""
+    semicolon list (default
+    ``data:1;data:2;data:4;data:2,pipe:2;data:4,model:2`` clipped to
+    visible devices), ``models`` (alexnet,transformer), ``tiny=1``
+    CPU-sized stand-ins, ``alexnet_batch``/``tf_batch`` per-chip
+    batch, ``dp_bucket_mb``."""
     import os
     args = dict(a.split("=", 1) for a in (argv or []) if "=" in a)
     dev = args.get("dev", "cpu")
     from cxxnet_tpu.parallel.mesh import MeshSpec
     mesh_strs = [m for m in args.get(
-        "meshes", "data:1;data:2;data:4;data:4,model:2").split(";") if m]
+        "meshes",
+        "data:1;data:2;data:4;data:2,pipe:2;data:4,model:2").split(";")
+        if m]
     specs = [MeshSpec.parse(m) for m in mesh_strs]
     if dev == "cpu":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -953,11 +1040,23 @@ def bench_mesh_scaling(argv=None) -> dict:
                   "overlap-on, comm/axis "
                   f"{last['overlap_on']['comm_share_per_axis']}",
                   file=sys.stderr)
+            for row in points:
+                on = row["overlap_on"]
+                if "pipe_bubble_share_measured" in on:
+                    print(f"bench: mesh-scaling {name} {row['mesh']} "
+                          f"pipe bubble measured "
+                          f"{on['pipe_bubble_share_measured']:.3f} vs "
+                          f"analytic "
+                          f"{on['pipe_bubble_share_analytic']:.3f} at "
+                          f"M={on['pipe_microbatch']}",
+                          file=sys.stderr)
     finally:
         for k, v in saved_opts.items():
             set_engine_option(k, v)
     head = models[0]
     last = out_models[head]["points"][-1]["overlap_on"]
+    pipe_rows = [r["overlap_on"] for r in out_models[head]["points"]
+                 if "pipe_bubble_share_measured" in r["overlap_on"]]
     return {
         "metric": "mesh_scaling_examples_per_sec_per_chip",
         "value": last["examples_per_sec_per_chip"],
@@ -967,6 +1066,13 @@ def bench_mesh_scaling(argv=None) -> dict:
         "scaling_efficiency": last["scaling_efficiency"],
         "comm_share": last["comm_share"],
         "comm_share_per_axis": last["comm_share_per_axis"],
+        **({"pipe_bubble": {
+            "mesh": pipe_rows[-1]["mesh"],
+            "pipe_microbatch": pipe_rows[-1]["pipe_microbatch"],
+            "measured": pipe_rows[-1]["pipe_bubble_share_measured"],
+            "analytic": pipe_rows[-1]["pipe_bubble_share_analytic"],
+            "probe": pipe_rows[-1].get("pipe_bubble_probe", ""),
+        }} if pipe_rows else {}),
         "models": out_models,
     }
 

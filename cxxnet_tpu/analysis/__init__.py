@@ -93,6 +93,12 @@ def _trace_findings(cfg, spmd: bool = True) -> List[Finding]:
     try:
         try:
             for k, v in cfg:
+                # the lint builds the trainer only to trace it: opening
+                # the config's telemetry sink would drop a "run" header
+                # into the linter's CWD for a run that never happens
+                # (task=check emits its own `check` record instead)
+                if k == "metrics_sink":
+                    continue
                 net.set_param(k, v)
             # no device work: abstract tracing on the host platform.
             # "cpu" wins over the config's dev= because set_param assigns

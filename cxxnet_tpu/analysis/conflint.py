@@ -366,6 +366,10 @@ def _cross_key_rules(pairs: ConfigPairs, layer_types: List[str],
         add(Finding("error", "pipe_microbatch",
                     f"batch_size = {batch_size} is not divisible by "
                     f"pipe_microbatch = {pipe_mb}"))
+    if "pipe_schedule" in last and not last.get("mesh"):
+        add(Finding("warn", "pipe_schedule",
+                    f"pipe_schedule = {last['pipe_schedule']} has no "
+                    "effect without a mesh = ...,pipe:K axis"))
     if last.get("dtype") == "bfloat16" \
             and last.get("pallas_ln", "1") not in ("0", "x") \
             and any(t == "layernorm" or t.startswith("pairtest-")
@@ -934,19 +938,53 @@ def _mesh_rules(last: Dict[str, str], layer_types: List[str],
                         f"stages but the net declares only "
                         f"{len(layer_types)} layer(s); stages would sit "
                         "empty — shrink the pipe axis or deepen the net"))
+        pipe_mb = _as_int(last, "pipe_microbatch", 0)
+        n_micro = pipe_mb or 2 * npipe
+        if n_micro % npipe:
+            add(Finding("error", "pipe_microbatch",
+                        f"pipe_microbatch = {n_micro} is not divisible "
+                        f"by the pipe axis ({npipe}): the schedule "
+                        "staggers one microbatch per stage, so ragged "
+                        "counts leave permanent extra bubble ticks — "
+                        "use a multiple of the axis"))
+        if pipe_mb == 0 and batch_size and batch_size % n_micro:
+            # the explicit-pipe_microbatch case is the keyed
+            # divisibility error above (lint_pairs); this covers the
+            # DEFAULTED count 2*S the trainer will actually use
+            add(Finding("error", "pipe_microbatch",
+                        f"batch_size = {batch_size} is not divisible by "
+                        f"the defaulted pipe_microbatch = {n_micro} "
+                        f"(2x the pipe axis); set pipe_microbatch "
+                        "explicitly or pad the batch"))
+        if _as_int(last, "remat", 0):
+            add(Finding("info", "remat",
+                        "remat with a pipe axis: the trainer rejects "
+                        "the combination — the pipeline schedule "
+                        "already recomputes each stage's forward "
+                        "inside its backward tick, so remat would "
+                        "recompute twice; drop remat"))
+    elif "pipe_schedule" in last:
+        add(Finding("warn", "pipe_schedule",
+                    f"pipe_schedule = {last['pipe_schedule']} has no "
+                    f"effect: mesh = {mesh_str} carries no pipe axis "
+                    "wider than 1"))
     if last.get("dp_overlap") != "1":
         return
     extra_ax = [a for a, s in axes.items()
                 if a not in ("data", "model") and s > 1]
     if "pipe" in extra_ax:
-        # the trainer's trace-time warn-once fallback, repeated at check
-        # time (the reason it is info here: the run still works, on the
-        # implicit-psum step)
-        add(Finding("info", "dp_overlap",
-                    "dp_overlap = 1 with a pipe axis: the pipeline "
-                    "schedule owns the backward walk, so the trainer "
-                    "takes the documented warn-once fallback to the "
-                    "implicit-psum step at trace time (doc/multichip.md)"))
+        # pipe_schedule = 1f1b COMPOSES with dp_overlap (bucketed
+        # (pipe, data) psums at cooldown grad-ready ticks) — no finding;
+        # only the gpipe fill-drain, whose backward is autodiff-
+        # scheduled, still takes the trainer's warn-once fallback
+        if last.get("pipe_schedule", "gpipe") != "1f1b":
+            add(Finding("info", "dp_overlap",
+                        "dp_overlap = 1 with the gpipe pipeline "
+                        "schedule: its backward is autodiff-scheduled, "
+                        "so the trainer keeps the implicit-psum step; "
+                        "set pipe_schedule = 1f1b to compose bucketed "
+                        "reductions with the pipe axis "
+                        "(doc/multichip.md)"))
         extra_ax = [a for a in extra_ax if a != "pipe"]
     if extra_ax:
         add(Finding("warn", "dp_overlap",

@@ -165,6 +165,14 @@ def _dp_findings(trainer) -> List[Finding]:
     if engine.opts.dp_overlap != "1":
         return []
     if not trainer._dp_overlap_active():
+        # 1F1B composes through its own plan: per-stage buckets whose
+        # (pipe, data) psums fire at cooldown grad-ready ticks — audit
+        # that plan's coverage instead of reporting the fallback
+        pipe_plan = trainer._pipe_bucket_plan() \
+            if trainer._pipelined else None
+        if pipe_plan is not None:
+            covered = [k for ks, _ in pipe_plan for k in ks]
+            return dp_coverage_findings(list(trainer.params), covered)
         return [Finding(
             "info", "", "dp_overlap = 1 is configured but inactive on "
             "this build (see the fallback warning above); bucket "

@@ -1021,6 +1021,12 @@ class LearnTask:
                                     if depth_n else 0.0,
                                     loss=None if loss is None
                                     else float(np.asarray(loss)))
+                                bub = getattr(self.net,
+                                              "pipe_bubble_frac", 0.0)
+                                if bub:
+                                    # pipelined step: ledger carves the
+                                    # fill/drain share out of dispatch
+                                    rec["pipe_bubble_frac"] = round(bub, 4)
                                 metrics.emit("step", **rec)
                                 if bank is not None:
                                     bank.observe_step(rec)
@@ -1086,6 +1092,9 @@ class LearnTask:
                                **round_metrics)
                     if rounds_done == 1 and self.compile_sec is not None:
                         rec["compile_sec"] = round(self.compile_sec, 3)
+                    bub = getattr(self.net, "pipe_bubble_frac", 0.0)
+                    if bub:
+                        rec["pipe_bubble_frac"] = round(bub, 4)
                     rec.update(self.net.memory_gauges())
                     metrics.emit("round", **rec)
                     if bank is not None:

@@ -83,8 +83,8 @@ def compare(metric: str, a, b, rel: float = 0.10,
 #: compile/eval/other shift with run shape, and the dispatch share
 #: (goodput) rises when the device merely slows down; those ride as
 #: context rows (direction None) instead
-_JUDGED_SHARES = ("input_wait", "h2d_staging", "ckpt_blocked",
-                  "rollback_lost")
+_JUDGED_SHARES = ("pipe_bubble", "input_wait", "h2d_staging",
+                  "ckpt_blocked", "rollback_lost")
 
 
 def run_metrics(recs: List[dict]

@@ -12,6 +12,12 @@ record attributing the measured wall into categories:
 ``compile``               first-dispatch jit trace + XLA compile wall
 ``dispatch``              host wall spent dispatching train steps — the
                           useful-work category goodput is computed from
+``pipe_bubble``           pipeline fill/drain idle inside the dispatched
+                          step: ``dispatch × pipe_bubble_frac`` carved out
+                          of the useful-work category.  Producers stamp
+                          ``pipe_bubble_frac`` (analytic ``(S-1)/(M+S-1)``
+                          from the trainer) on step/round records of
+                          pipelined runs; absent field → 0 carve
 ``input_wait``            blocked on the host iterator / staging queue
 ``h2d_staging``           critical-path device staging (stack + cast +
                           transfer).  With ``prefetch_device > 0`` the
@@ -53,8 +59,9 @@ from typing import Dict, List, Optional
 from . import log as mlog
 
 #: ledger categories, in render order; they tile ``wall_sec``
-CATEGORIES = ("compile", "dispatch", "input_wait", "h2d_staging",
-              "eval", "ckpt_blocked", "rollback_lost", "other")
+CATEGORIES = ("compile", "dispatch", "pipe_bubble", "input_wait",
+              "h2d_staging", "eval", "ckpt_blocked", "rollback_lost",
+              "other")
 
 
 def parse_record_line(line: str):
@@ -164,7 +171,7 @@ def build_ledger(recs: List[dict],
         if recs[i].get("kind") == "ledger":
             recs = recs[i + 1:]
             break
-    compile_sec = dispatch = input_wait = eval_sec = 0.0
+    compile_sec = dispatch = bubble = input_wait = eval_sec = 0.0
     h2d_raw = ckpt_blocked = lost = 0.0
     kept: List[dict] = []       # completed rounds still standing
     rounds_lost = 0
@@ -172,7 +179,7 @@ def build_ledger(recs: List[dict],
     # at round end, carries the SAME round's full sums — so pending step
     # marks are superseded (discarded) when their round record lands,
     # and only the dying round's partial accounting survives the stream
-    pend = {"dispatch": 0.0, "input_wait": 0.0, "h2d": 0.0}
+    pend = {"dispatch": 0.0, "bubble": 0.0, "input_wait": 0.0, "h2d": 0.0}
     # compile happens INSIDE its round's wall (the first dispatch), so
     # a rolled-back round's lost wall must shed the compile portion the
     # `compile` category already booked — the compile record's round is
@@ -192,12 +199,19 @@ def build_ledger(recs: List[dict],
             if r.get("round") is not None:
                 compile_by_round[int(r["round"])] = _f(r, "compile_sec")
         elif k == "step":
-            pend["dispatch"] += _f(r, "dispatch_sec")
+            # pipelined steps spend a known fill/drain fraction of their
+            # dispatch wall idle (pipe_bubble_frac, stamped by main.py):
+            # carve it out of the useful-work category
+            d = _f(r, "dispatch_sec")
+            bub = d * _f(r, "pipe_bubble_frac")
+            pend["dispatch"] += d - bub
+            pend["bubble"] += bub
             pend["input_wait"] += _f(r, "iter_wait_sec")
             pend["h2d"] += _f(r, "h2d_sec")
         elif k == "round":
             kept.append(r)
-            pend = {"dispatch": 0.0, "input_wait": 0.0, "h2d": 0.0}
+            pend = {"dispatch": 0.0, "bubble": 0.0,
+                    "input_wait": 0.0, "h2d": 0.0}
         elif k == "ckpt":
             ckpt_blocked += _f(r, "blocked_sec")
         elif k == "rollback":
@@ -219,14 +233,19 @@ def build_ledger(recs: List[dict],
                         int(q.get("round") or 0) - 1, 0.0)
                     lost += max(_f(q, "wall_sec") - nested, 0.0) \
                         + _f(q, "eval_sec")
-            lost += pend["dispatch"] + pend["input_wait"] + pend["h2d"]
-            pend = {"dispatch": 0.0, "input_wait": 0.0, "h2d": 0.0}
+            lost += pend["dispatch"] + pend["bubble"] \
+                + pend["input_wait"] + pend["h2d"]
+            pend = {"dispatch": 0.0, "bubble": 0.0,
+                    "input_wait": 0.0, "h2d": 0.0}
         elif k == "anomaly":
             n_anom += 1
         elif k == "nan":
             n_nan += 1
     for r in kept:
-        dispatch += _f(r, "dispatch_sec")
+        d = _f(r, "dispatch_sec")
+        bub = d * _f(r, "pipe_bubble_frac")
+        dispatch += d - bub
+        bubble += bub
         input_wait += _f(r, "iter_wait_sec")
         eval_sec += _f(r, "eval_sec")
         h2d_raw += _f(r, "h2d_sec")
@@ -234,6 +253,7 @@ def build_ledger(recs: List[dict],
     # leaves its last round as step marks only — book them where the
     # time actually went instead of letting the whole round read "other"
     dispatch += pend["dispatch"]
+    bubble += pend["bubble"]
     input_wait += pend["input_wait"]
     h2d_raw += pend["h2d"]
     if wall_sec is None:
@@ -241,7 +261,7 @@ def build_ledger(recs: List[dict],
             return None
         wall_sec = max(last_ts - first_ts, 0.0)
     wall_sec = float(wall_sec)
-    base = (compile_sec + dispatch + input_wait + eval_sec
+    base = (compile_sec + dispatch + bubble + input_wait + eval_sec
             + ckpt_blocked + lost)
     residual = wall_sec - base
     # h2d that ran on the prefetch producer thread overlapped compute
@@ -251,9 +271,10 @@ def build_ledger(recs: List[dict],
     h2d_staging = min(h2d_raw, max(residual, 0.0))
     other = max(wall_sec - base - h2d_staging, 0.0)
     cats = {"compile": compile_sec, "dispatch": dispatch,
-            "input_wait": input_wait, "h2d_staging": h2d_staging,
-            "eval": eval_sec, "ckpt_blocked": ckpt_blocked,
-            "rollback_lost": lost, "other": other}
+            "pipe_bubble": bubble, "input_wait": input_wait,
+            "h2d_staging": h2d_staging, "eval": eval_sec,
+            "ckpt_blocked": ckpt_blocked, "rollback_lost": lost,
+            "other": other}
     cats = {k: round(v, 4) for k, v in cats.items()}
     denom = wall_sec or 1.0
     return {

@@ -171,6 +171,7 @@ class NetTrainer:
         # chain's compute into another's prefetch stalls
         self.batch_split = 1
         self._pipe_partition = None
+        self._pipe_bucket_state = None
         # u8 input path: normalization constants applied ON DEVICE when a
         # batch arrives as uint8 (4x less host work + 2-4x less transfer;
         # the subtract/multiply fuses into conv1)
@@ -880,6 +881,76 @@ class NetTrainer:
                                    rng, epoch, mask, train=train,
                                    body_loss=aux_losses.sum())
 
+    @property
+    def pipe_bubble_frac(self) -> float:
+        """Analytic pipeline-bubble share of the step, ``(S-1)/(M+S-1)``
+        (S stages, M micro-batches): the fraction of schedule ticks a
+        stage idles during fill/drain.  0.0 on un-pipelined meshes.
+        Stamped on step/round records so the goodput ledger can carve
+        ``pipe_bubble`` out of dispatch (monitor/ledger.py)."""
+        if not self._pipelined:
+            return 0.0
+        s = self.mesh.shape["pipe"]
+        m = self.pipe_microbatch or 2 * s
+        return (s - 1) / (m + s - 1)
+
+    def _pipe_bucket_plan(self):
+        """Bucket plan for the dp_overlap x pipe composition, or None
+        (implicit whole-tree reduction).  Each pipeline stage's param
+        keys — plus the loss tail's, riding the last stage — become
+        ``dp_bucket_mb``-bounded buckets tagged with the stage whose
+        cooldown tick makes them grad-ready.  A key read by several
+        stages is assigned the LOWEST stage index (lower stages complete
+        later, so every contribution is final when the bucket fires)."""
+        if engine.opts.dp_overlap != "1" \
+                or self.pipe_schedule != "1f1b" \
+                or "data" not in self.mesh.axis_names \
+                or self.mesh.shape["data"] < 2:
+            return None
+        if self._pipe_bucket_state is None:
+            from ..parallel import overlap
+            stages, body_end = self._pipe_setup()
+            n_stage = len(stages)
+            owner = {}  # key -> lowest stage index reading it
+            for s, (s0, s1) in enumerate(stages):
+                for key in overlap._keys_read(self.net, s0, s1,
+                                              self.params):
+                    owner.setdefault(key, s)
+            for key in overlap._keys_read(
+                    self.net, body_end, len(self.net.connections),
+                    self.params):
+                owner.setdefault(key, n_stage - 1)
+            bucket_bytes = max(
+                float(engine.opts.dp_bucket_mb) * 2 ** 20, 1.0)
+            buckets = []
+            for s in range(n_stage):
+                # reverse layer order within the stage (backward reaches
+                # the last connection's grads first — the async_updater
+                # fill order), chunked to the wire-size target
+                keys = [k for k in reversed(list(owner))
+                        if owner[k] == s]
+                cur, acc = [], 0.0
+                for key in keys:
+                    cur.append(key)
+                    acc += overlap._group_bytes(self.params[key])
+                    if acc >= bucket_bytes:
+                        buckets.append((tuple(cur), s))
+                        cur, acc = [], 0.0
+                if cur:
+                    buckets.append((tuple(cur), s))
+            self._pipe_bucket_state = (tuple(buckets),)
+            if not mlog.is_silent():
+                mlog.info(
+                    "pipe dp_overlap: %d bucket(s) over %d stages "
+                    "(KiB: %s), reduce_dtype=%s — (pipe, data) psums "
+                    "issue at cooldown grad-ready ticks" % (
+                        len(buckets), n_stage,
+                        ",".join(str(sum(overlap._group_bytes(
+                            self.params[k]) for k in ks) // 1024)
+                            for ks, _ in buckets),
+                        engine.opts.dp_reduce_dtype))
+        return self._pipe_bucket_state[0]
+
     def _pipeline_1f1b_loss_and_grads(self, params, buffers, data,
                                       label_vec, epoch, rng, eval_ids,
                                       mask):
@@ -921,17 +992,26 @@ class NetTrainer:
                 total = total + l
             return total
 
-        loss, grads, outs = pipeline_1f1b_hetero(
+        from ..parallel.overlap import REDUCE_DTYPES
+        buckets = self._pipe_bucket_plan()
+        _, grads, outs, auxs = pipeline_1f1b_hetero(
             stage_fns, tail_loss, params, x, mesh=self.mesh,
-            data_spec=self.batch_shard.spec, extra=extra)
-        # train-metric eval nodes: forward the loss tail once on the
-        # collected last-boundary activations (no grad — the 1F1B scan
-        # already produced the gradients)
+            data_spec=self.batch_shard.spec, extra=extra,
+            buckets=None if buckets is None else list(buckets),
+            reduce_dtype=None if buckets is None
+            else REDUCE_DTYPES[engine.opts.dp_reduce_dtype])
+        # train-metric eval nodes + the REPORTED loss: forward the loss
+        # tail once on the collected last-boundary activations (no grad —
+        # the 1F1B scan already produced the gradients).  Using this
+        # full-batch tail total, rather than the schedule's ascending
+        # per-microbatch sum, makes the reported loss the SAME reduction
+        # the gpipe path computes — bitwise comparable
         nodes = {n: o.reshape(b, *o.shape[2:])
                  for n, o in zip(frontier, outs)}
         nodes, ctx = self._run_loss_tail(params, nodes, body_end,
                                          label_vec, rng, epoch, mask,
-                                         train=True)
+                                         train=True, body_loss=auxs.sum())
+        loss = sum(ctx.losses[1:], ctx.losses[0])
         for nid in eval_ids:
             assert nid in nodes, (
                 "pipeline: train-metric eval nodes must sit at or "
@@ -1054,16 +1134,27 @@ class NetTrainer:
         if "data" not in mesh.axis_names or mesh.shape["data"] < 2:
             self._dp_warn_once("mesh has no data axis wider than 1")
             return False
+        if self._pipelined:
+            # pipe_schedule = 1f1b composes instead of falling back: the
+            # pipelined step issues its own bucketed (pipe, data)
+            # reductions at each stage's cooldown grad-ready tick
+            # (_pipe_bucket_plan); only the gpipe fill-drain — whose
+            # backward is autodiff-scheduled — still takes the implicit
+            # step
+            if self.pipe_schedule != "1f1b":
+                self._dp_warn_once(
+                    "the gpipe pipeline schedule's backward is autodiff-"
+                    "scheduled (pipe_schedule = 1f1b composes)")
+            return False
         # a "model" axis composes (weight shards gather at segment entry,
-        # parallel/overlap.py); seq/expert/pipe collectives are placed by
+        # parallel/overlap.py); seq/expert collectives are placed by
         # GSPMD/shard_map machinery the sliced-vjp walk can't host
         extra_axes = [a for a in mesh.axis_names
                       if a not in ("data", "model") and mesh.shape[a] > 1]
         if extra_axes:
             self._dp_warn_once(
                 f"mesh axes {'/'.join(extra_axes)} need GSPMD-placed "
-                "collectives (ring attention / expert all-to-all / "
-                "pipeline)")
+                "collectives (ring attention / expert all-to-all)")
             return False
         if self._dp_model_axis():
             from ..layers.moe import MoELayer
@@ -1079,8 +1170,8 @@ class NetTrainer:
                     "the model axis hosts MoE experts; dispatch/combine "
                     "all-to-alls are GSPMD-placed")
                 return False
-        if self._pipelined or self.remat or self.batch_split > 1:
-            self._dp_warn_once("pipe/remat/batch_split paths schedule "
+        if self.remat or self.batch_split > 1:
+            self._dp_warn_once("remat/batch_split paths schedule "
                                "their own backward")
             return False
         if self.buffers:

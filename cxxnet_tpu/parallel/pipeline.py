@@ -313,7 +313,7 @@ def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x, labels, *,
 
 def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
                          axis: str = "pipe", data_spec: P = P(),
-                         extra=None):
+                         extra=None, buckets=None, reduce_dtype=None):
     """1F1B schedule over *heterogeneous* stages — the netconfig-integrated
     counterpart of :func:`pipeline_1f1b` (``pipe_schedule = 1f1b``).
 
@@ -323,31 +323,71 @@ def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
     output boundary for one microbatch to the scalar training loss
     (trailing loss connections + the threaded aux terms).  ``x`` is
     ``(n_micro, mb, ...)`` microbatches; ``extra`` the per-microbatch
-    label-fields/mask pytree.  Returns ``(loss, grads, outs)``: summed
-    per-microbatch loss, parameter gradients (f32, summed over pipe +
-    data axes, replicated), and the stacked last-boundary activations
-    (``(n_micro, mb, ...)`` per frontier node) for train-metric eval.
+    label-fields/mask pytree.  Returns ``(loss, grads, outs, auxs)``:
+    summed per-microbatch loss, parameter gradients (f32, summed over
+    pipe + data axes, replicated), the stacked last-boundary activations
+    (``(n_micro, mb, ...)`` per frontier node) for train-metric eval,
+    and the ``(n_micro,)`` per-microbatch aux-loss vector (mid-body loss
+    terms, summed over data shards).
 
     Schedule identical to :func:`pipeline_1f1b` (stage ``s`` forwards
     microbatch ``t - s`` and backwards ``t - (2S - 2 - s)`` at tick
-    ``t``); because boundary shapes differ per stage, the rotating
-    buffers and saved-input rings are K-tuples (one slot per boundary,
-    every device carries all K — the uniform-SPMD-program requirement),
-    so the activation footprint is ``(2S - 1) * sum_s |boundary_s|``,
-    flat in ``n_micro`` where GPipe-by-autodiff stores all ``n_micro``
-    tick residuals.  Per-stage forward recompute inside ``jax.vjp`` is
-    the standard 1F1B trade; randomness keys match the forward half
-    (``fold_in(rng, m * S + s)`` in make_stage_fns), so dropout masks
-    agree between the two passes.
+    ``t``).  Because boundary shapes differ per stage, the rotating
+    buffers and saved-input rings are K-tuples (every device carries all
+    K — the uniform-SPMD-program requirement); stage ``s``'s saved-input
+    ring holds ``2(S - 1 - s) + 1`` slots (its forward-to-backward gap),
+    so the total in-flight activation footprint averages S microbatch
+    sets per boundary and is flat in ``n_micro``, where GPipe-by-autodiff
+    stores all ``n_micro`` tick residuals.  Per-stage forward recompute
+    inside ``jax.vjp`` is the standard 1F1B trade; randomness keys match
+    the forward half (``fold_in(rng, m * S + s)`` in make_stage_fns), so
+    dropout masks agree between the two passes.
+
+    Phasing: the first ``T - S`` ticks (warmup + steady 1F1B interleave)
+    run under one ``lax.scan``; the last ``S`` ticks — the cooldown,
+    where stage ``S-1-k`` completes its final backward on cooldown tick
+    ``k`` — are unrolled so a gradient reduction can be ISSUED at each
+    stage's grad-ready point.  ``buckets``, when given, is a list of
+    ``(param_keys, stage)`` pairs: after cooldown tick ``k`` every
+    bucket whose owning stage just completed is ``psum``'d over
+    ``(pipe, data)`` (dp_overlap composed with the pipe axis — the
+    async_updater schedule, bucket k's wire overlapping stage k-1's
+    remaining backward ticks).  A key read by several stages must be
+    assigned to the LOWEST stage index reading it: lower stages complete
+    later, so every contribution is final when its bucket fires.
+    ``buckets = None`` reduces the whole tree once after the last tick
+    (the implicit step).  Both placements reduce the same per-device
+    accumulators, so at ``reduce_dtype = None`` (f32 wire) the
+    trajectories are bitwise identical — asserted in
+    tests/test_pipeline_1f1b.py.  ``reduce_dtype`` casts bucket wires
+    (``dp_reduce_dtype = bf16``: half the comm volume, f32 master apply).
     """
     n_stage = mesh.shape[axis]
     n_micro = x.shape[0]
     ticks = n_micro + 2 * n_stage - 2
-    ring = 2 * n_stage - 1
+    # stage s's forward of microbatch m lands at tick m + s, its backward
+    # at m + 2(S-1) - s: the ring only needs the gap + 1 slots (plus one
+    # scratch slot inactive ticks write into)
+    rings_len = [2 * (n_stage - 1 - s) + 1 for s in range(n_stage)]
     fwd_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
     bwd_perm = [(i, (i - 1) % n_stage) for i in range(n_stage)]
     data_axes = [a for d in data_spec if d is not None
                  for a in (d if isinstance(d, tuple) else (d,))]
+    red_axes = (axis, *data_axes)
+    if buckets is not None:
+        covered = [k for keys, _ in buckets for k in keys]
+        assert sorted(covered) == sorted(params), (
+            "pipeline buckets must cover every param key exactly once",
+            sorted(covered), sorted(params))
+
+    def reduce_bucket(sub):
+        """psum a grad subtree over (pipe, data), optionally over a
+        narrower wire dtype (cast back for the f32 master apply)."""
+        def leaf(g):
+            cast = reduce_dtype is not None and g.dtype != reduce_dtype
+            r = lax.psum(g.astype(reduce_dtype) if cast else g, red_axes)
+            return r.astype(g.dtype) if cast else r
+        return jax.tree.map(leaf, sub)
 
     def spmd(params, xs, *erest):
         idx = lax.axis_index(axis)
@@ -377,27 +417,28 @@ def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
             fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
 
             def mk_branch(s):
-                def br(carry):
+                ring = rings_len[s]
+
+                def fwd_half(carry):
                     fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
-                    # ------------------------------------ forward half
-                    mf = t - s
-                    f_on = (mf >= 0) & (mf < n_micro)
-                    mf_c = jnp.clip(mf, 0, n_micro - 1)
+                    mf_c = jnp.clip(t - s, 0, n_micro - 1)
                     inp = ((xs[mf_c],), jnp.float32(0.0)) if s == 0 \
                         else fwd_bufs[s - 1]
-                    slot = jnp.where(f_on, mf_c % ring, ring)
                     rings = tuple(
                         jax.tree.map(
                             lambda buf, v: lax.dynamic_update_slice_in_dim(
-                                buf, v[None], slot, axis=0), rings[j], inp)
+                                buf, v[None], mf_c % ring, axis=0),
+                            rings[j], inp)
                         if j == s else rings[j] for j in range(n_stage))
                     y = run_fwd(s, params, inp[0], inp[1], mf_c)
                     fwd_bufs = tuple(y if j == s else fwd_bufs[j]
                                      for j in range(n_stage))
-                    # ----------------------------------- backward half
-                    mb = t - (2 * n_stage - 2 - s)
-                    b_on = (mb >= 0) & (mb < n_micro)
-                    mb_c = jnp.clip(mb, 0, n_micro - 1)
+                    return fwd_bufs, ct_bufs, rings, grad_acc, loss_acc
+
+                def bwd_half(carry):
+                    fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
+                    mb_c = jnp.clip(t - (2 * n_stage - 2 - s), 0,
+                                    n_micro - 1)
                     saved = jax.tree.map(
                         lambda buf: lax.dynamic_index_in_dim(
                             buf, mb_c % ring, axis=0, keepdims=False),
@@ -422,24 +463,35 @@ def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
                             params, saved[0], saved[1])
                         dp, da, dl = vjp(ct_bufs[s])
                         loss_m = jnp.float32(0.0)
-                    # where-mask, not multiply: bubble ticks run the vjp
-                    # on zero/garbage activations and 0 * NaN would
-                    # poison the accumulator permanently
                     grad_acc = jax.tree.map(
-                        lambda a, d: jnp.where(b_on, a + d.astype(a.dtype),
-                                               a),
-                        grad_acc, dp)
-                    loss_acc = loss_acc + jnp.where(b_on, loss_m, 0.0)
+                        lambda a, d: a + d.astype(a.dtype), grad_acc, dp)
+                    loss_acc = loss_acc + loss_m
                     if s >= 1:
                         ct_bufs = tuple((da, dl) if j == s - 1 else ct_bufs[j]
                                         for j in range(n_stage))
                     return fwd_bufs, ct_bufs, rings, grad_acc, loss_acc
+
+                def br(carry):
+                    # each half gated by a RUNTIME conditional, not a
+                    # mask: XLA executes only the taken branch, so
+                    # warmup/cooldown bubble ticks cost one half (or
+                    # nothing) instead of a full fwd+bwd — the classic
+                    # (M + S - 1)-slot wall, and the reason the measured
+                    # bubble share lands on (S-1)/(M+S-1) instead of
+                    # twice that.  (It also means bubble ticks never run
+                    # a vjp on garbage activations.)
+                    mf = t - s
+                    mb = t - (2 * n_stage - 2 - s)
+                    f_on = (mf >= 0) & (mf < n_micro)
+                    b_on = (mb >= 0) & (mb < n_micro)
+                    carry = lax.cond(f_on, fwd_half, lambda c: c, carry)
+                    return lax.cond(b_on, bwd_half, lambda c: c, carry)
                 return br
 
             carry = lax.switch(idx, [mk_branch(s) for s in range(n_stage)],
                                carry)
             fwd_bufs, ct_bufs, rings, grad_acc, loss_acc = carry
-            y_last = fwd_bufs[n_stage - 1][0]
+            y_last = fwd_bufs[n_stage - 1]
             fwd_bufs = tuple(
                 jax.tree.map(lambda a: lax.ppermute(a, axis, fwd_perm), b)
                 for b in fwd_bufs)
@@ -448,28 +500,50 @@ def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
                 for b in ct_bufs)
             return (fwd_bufs, ct_bufs, rings, grad_acc, loss_acc), y_last
 
-        init = (tuple(zeros_of(b) for b in bshapes),
-                tuple(zeros_of(b) for b in bshapes),
-                tuple(jax.tree.map(
-                    lambda a: jnp.zeros((ring + 1,) + a.shape, a.dtype),
-                    in_shapes[s]) for s in range(n_stage)),
-                jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                             params),
-                jnp.float32(0.0))
-        carry, ys = lax.scan(tick, init, jnp.arange(ticks))
+        carry = (tuple(zeros_of(b) for b in bshapes),
+                 tuple(zeros_of(b) for b in bshapes),
+                 tuple(jax.tree.map(
+                     lambda a: jnp.zeros((rings_len[s] + 1,) + a.shape,
+                                         a.dtype),
+                     in_shapes[s]) for s in range(n_stage)),
+                 jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                              params),
+                 jnp.float32(0.0))
+        # warmup + steady interleave under one scan; the S cooldown ticks
+        # unroll so bucket reductions can issue at grad-ready points
+        carry, ys = lax.scan(tick, carry, jnp.arange(ticks - n_stage))
+        cool_y = []
+        reduced = {}
+        for k in range(n_stage):
+            carry, y_last = tick(carry, jnp.int32(ticks - n_stage + k))
+            cool_y.append(y_last)
+            if buckets is not None:
+                done = n_stage - 1 - k  # the stage this tick completed
+                grad_acc = carry[3]
+                for keys, st in buckets:
+                    if st == done:
+                        reduced.update(reduce_bucket(
+                            {key: grad_acc[key] for key in keys}))
         _, _, _, grad_acc, loss_acc = carry
-        # microbatch m leaves the last stage at tick m + S - 1
+        # microbatch m leaves the last stage at tick m + S - 1; the last
+        # one (m = n_micro - 1) exits on the FIRST cooldown tick
         out_last = jax.tree.map(
-            lambda a: a[n_stage - 1:n_stage - 1 + n_micro], ys)
+            lambda a, b: jnp.concatenate(
+                [a[n_stage - 1:n_stage - 1 + n_micro - 1], b[None]], 0),
+            ys, cool_y[0])
         valid = idx == n_stage - 1
         out_last = jax.tree.map(
             lambda a: a * valid.astype(a.dtype), out_last)
-        outs = lax.psum(out_last, axis)
+        outs, auxs = lax.psum(out_last, axis)
         loss = lax.psum(loss_acc, axis)
-        grads = lax.psum(grad_acc, (axis, *data_axes))
+        if buckets is not None:
+            grads = {key: reduced[key] for key in params}
+        else:
+            grads = lax.psum(grad_acc, red_axes)
         if data_axes:
             loss = lax.psum(loss, tuple(data_axes))
-        return loss, grads, outs
+            auxs = lax.psum(auxs, tuple(data_axes))
+        return loss, grads, outs, auxs
 
     pspec = jax.tree.map(lambda _: P(), params)
     xspec = P(None, *data_spec)
@@ -480,7 +554,7 @@ def pipeline_1f1b_hetero(stage_fns, tail_loss_fn, params, x, *, mesh: Mesh,
     gspec = jax.tree.map(lambda _: P(), params)
     return shard_map(
         spmd, mesh=mesh,
-        in_specs=in_specs, out_specs=(P(), gspec, xspec),
+        in_specs=in_specs, out_specs=(P(), gspec, xspec, P(None)),
         check_rep=False)(*operands)
 
 

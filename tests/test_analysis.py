@@ -297,19 +297,58 @@ def test_rule_pipe_axis_needs_multi_stage_net():
     assert not any("stages" in f.message for f in by_key(deep, "mesh"))
 
 
-def test_rule_pipe_with_dp_overlap_is_info():
-    """pipe x dp_overlap repeats the trainer's documented warn-once
-    fallback as a lint info (the run still works, implicitly)."""
+def test_rule_pipe_with_dp_overlap_gpipe_only():
+    """dp_overlap x pipe: the gpipe schedule still takes the trainer's
+    warn-once fallback (lint info); pipe_schedule = 1f1b COMPOSES
+    (bucketed reductions at cooldown grad-ready ticks) and must stay
+    quiet — the PR 14 INFO rule retired with the fallback."""
     findings = conflint.lint_pairs(parse_config_string(
         "dp_overlap = 1\nmesh = data:2,pipe:2\ndev = cpu:0-3\n"))
     hits = [f for f in by_key(findings, "dp_overlap")
-            if "pipeline schedule" in f.message]
+            if "gpipe" in f.message]
     assert hits and hits[0].severity == "info"
+    composed = conflint.lint_pairs(parse_config_string(
+        "dp_overlap = 1\nmesh = data:2,pipe:2\ndev = cpu:0-3\n"
+        "pipe_schedule = 1f1b\n"))
+    assert not by_key(composed, "dp_overlap")
     # a seq axis still gets the generic fallback WARN, not the info
     seq = conflint.lint_pairs(parse_config_string(
         "dp_overlap = 1\nmesh = data:2,seq:2\ndev = cpu:0-3\n"))
     assert any(f.severity == "warn" and "fall back" in f.message
                for f in by_key(seq, "dp_overlap"))
+
+
+def test_rule_pipe_schedule_cross_keys():
+    """The 1F1B cross-key rules: microbatch-count divisibility by the
+    pipe axis is an error, the defaulted 2*S count must divide the
+    batch, a schedule key without a pipe axis warns, and remat x pipe
+    gets the interaction note."""
+    ragged = conflint.lint_pairs(parse_config_string(
+        "mesh = pipe:2\ndev = cpu:0-1\npipe_microbatch = 3\n"
+        "batch_size = 6\n"))
+    assert any(f.severity == "error" and "staggers" in f.message
+               for f in by_key(ragged, "pipe_microbatch"))
+    dflt = conflint.lint_pairs(parse_config_string(
+        "mesh = pipe:2\ndev = cpu:0-1\nbatch_size = 6\n"))
+    assert any(f.severity == "error" and "defaulted" in f.message
+               for f in by_key(dflt, "pipe_microbatch"))
+    nopipe = conflint.lint_pairs(parse_config_string(
+        "mesh = data:2\ndev = cpu:0-1\npipe_schedule = 1f1b\n"))
+    assert any(f.severity == "warn" and "no pipe axis" in f.message
+               for f in by_key(nopipe, "pipe_schedule"))
+    nomesh = conflint.lint_pairs(parse_config_string(
+        "pipe_schedule = 1f1b\n"))
+    assert any(f.severity == "warn" for f in by_key(nomesh,
+                                                    "pipe_schedule"))
+    rm = conflint.lint_pairs(parse_config_string(
+        "mesh = pipe:2\ndev = cpu:0-1\nremat = 2\n"))
+    assert any(f.severity == "info" and "recompute twice" in f.message
+               for f in by_key(rm, "remat"))
+    clean = conflint.lint_pairs(parse_config_string(
+        "mesh = data:2,pipe:2\ndev = cpu:0-3\npipe_schedule = 1f1b\n"
+        "pipe_microbatch = 4\nbatch_size = 16\n"))
+    assert not by_key(clean, "pipe_microbatch")
+    assert not by_key(clean, "pipe_schedule")
 
 
 def test_rule_dp_reduce_dtype_without_overlap_warns():
@@ -560,6 +599,23 @@ def test_task_check_cli_exit_codes(tmp_path, capsys):
     assert rc == 1
     err = capsys.readouterr().err
     assert "dp_bucket_mb" in err  # did-you-mean printed
+
+
+def test_task_check_emits_only_check_record(tmp_path):
+    """The check task's traced pass builds a trainer but must NOT open
+    the config's telemetry sink for it: a lint is read-only — the only
+    record in the stream is the `check` record, never the trainer's
+    `run` header (regression: graftlint over example confs with relative
+    sink paths used to drop run-header debris into the linter's CWD)."""
+    from cxxnet_tpu.main import LearnTask
+    conf = os.path.join(REPO, "example/MNIST/MNIST.conf")
+    sink = tmp_path / "m.jsonl"
+    rc = LearnTask().run(
+        [conf, "task=check", "silent=1", f"metrics_sink=jsonl:{sink}"])
+    assert rc == 0
+    import json
+    kinds = [json.loads(l)["kind"] for l in sink.read_text().splitlines()]
+    assert kinds == ["check"]
 
 
 def test_task_check_no_netconfig_skips_trace():

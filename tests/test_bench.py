@@ -75,6 +75,34 @@ def test_bench_mesh_scaling_mode():
     assert opts.dp_overlap == "0"
 
 
+def test_bench_mesh_scaling_pipe_line():
+    """--mesh-scaling on a pipe mesh: the point runs the 1F1B schedule
+    and grows the bubble columns — measured share from the two-point
+    microbatch probe, analytic (S-1)/(M+S-1), and the microbatch count
+    — plus the pipe row in the payload summary.  Measured magnitude is
+    not asserted (CPU timing noise at tiny scale); presence + analytic
+    value are."""
+    import bench
+    payload = bench.bench_mesh_scaling(
+        ["dev=cpu", "tiny=1", "meshes=data:2,pipe:2", "models=alexnet"])
+    pts = payload["models"]["alexnet"]["points"]
+    assert [p["mesh"] for p in pts] == ["data:2,pipe:2"]
+    for tag in ("overlap_on", "overlap_off"):
+        p = pts[0][tag]
+        assert p["pipe_microbatch"] == 4  # 2x the pipe axis
+        assert p["pipe_bubble_share_analytic"] == round(1 / 5, 4)
+        assert p["pipe_bubble_share_measured"] >= 0.0
+        assert p["pipe_bubble_probe"] in (
+            "wall-two-point", "serialized-excess-work")
+        assert isinstance(p["comm_share_per_axis"], dict)
+    assert payload["pipe_bubble"]["mesh"] == "data:2,pipe:2"
+    assert payload["pipe_bubble"]["analytic"] == round(1 / 5, 4)
+    assert payload["pipe_bubble"]["probe"] in (
+        "wall-two-point", "serialized-excess-work")
+    from cxxnet_tpu.engine import opts
+    assert opts.dp_overlap == "0"
+
+
 def test_bench_opt_ab_mode():
     """--opt-ab payload on CPU (tiny): one entry per arm with step_ms
     and the arm's engine options, plus base-relative speedups; engine

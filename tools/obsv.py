@@ -345,6 +345,13 @@ def render(rep: dict) -> str:
             [[c, _fmt(cats.get(c)),
               (f"{shares[c]:.1%}" if c in shares else "-")]
              for c in CATEGORIES if cats.get(c) is not None]))
+        if cats.get("pipe_bubble"):
+            in_step = cats["pipe_bubble"] / max(
+                cats["pipe_bubble"] + (cats.get("dispatch") or 0.0), 1e-9)
+            out.append(f"pipe bubble: {in_step:.1%} of the dispatched "
+                       "step wall is fill/drain idle (analytic "
+                       "(S-1)/(M+S-1) — raise pipe_microbatch to shrink "
+                       "it; measured share: bench.py --mesh-scaling)")
     rounds = rep.get("rounds")
     if rounds:
         out.append("")
