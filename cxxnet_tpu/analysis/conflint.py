@@ -26,6 +26,7 @@ exceptions into findings.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import registry
@@ -737,7 +738,9 @@ def _text_rules(pairs: ConfigPairs, last: Dict[str, str],
 _DECODE_KEYS = ("serve_gen", "decode_slots", "decode_max_seqlen",
                 "serve_gen_tokens", "serve_gen_sample", "serve_gen_temp",
                 "serve_gen_topk", "serve_gen_seed", "serve_gen_eos",
-                "serve_gen_prompt", "serve_gen_batching")
+                "serve_gen_prompt", "serve_gen_batching",
+                "serve_draft_model", "spec_k", "decode_prefill_chunk",
+                "decode_kv_dtype")
 
 
 def _decode_rules(pairs: ConfigPairs, last: Dict[str, str],
@@ -760,7 +763,14 @@ def _decode_rules(pairs: ConfigPairs, last: Dict[str, str],
       ``task=check``'s memory pass makes for train steps (doc/memory.md)
       — surfaced analytically here, no trace needed;
     * sampling detail keys that the selected ``serve_gen_sample`` kind
-      ignores warn.
+      ignores warn;
+    * speculative decoding: ``spec_k`` without ``serve_draft_model``
+      errors, a missing draft snapshot errors at check time (info when
+      ``model_in`` is missing too — an untrained example tree), a draft
+      with ``spec_k = 0`` warns, and non-greedy sampling + speculation
+      gets the rejection-sampling reproducibility note;
+    * ``decode_prefill_chunk`` that does not divide the cache length
+      warns (the last chunk pads dead columns).
     """
     gen = _as_int(last, "serve_gen", 0)
     if task != "serve":
@@ -878,6 +888,48 @@ def _decode_rules(pairs: ConfigPairs, last: Dict[str, str],
                     "serve_gen_sample = topk without serve_gen_topk: "
                     "the cutoff defaults to the full vocabulary "
                     "(plain temperature sampling)"))
+    # --- speculative decoding + chunked prefill (doc/serve.md)
+    spec_k = _as_int(last, "spec_k", 0)
+    draft = last.get("serve_draft_model", "")
+    if spec_k >= 1 and not draft:
+        add(Finding("error", "spec_k",
+                    f"spec_k = {spec_k} without serve_draft_model: "
+                    "speculation needs a draft snapshot to propose "
+                    "tokens (doc/serve.md)"))
+    if draft:
+        if not os.path.exists(draft):
+            model_in = last.get("model_in", "NULL")
+            have_flagship = model_in != "NULL" \
+                and os.path.exists(model_in)
+            # an example tree checked in without trained weights lints
+            # the conf shape, not the filesystem: downgrade when the
+            # flagship snapshot is missing too
+            sev = "error" if have_flagship else "info"
+            add(Finding(sev, "serve_draft_model",
+                        f"draft snapshot {draft!r} does not exist"
+                        + ("" if have_flagship else
+                           " (neither does model_in — train both "
+                           "before serving)")))
+        if spec_k < 1:
+            add(Finding("warn", "serve_draft_model",
+                        "serve_draft_model configured but spec_k is "
+                        f"{spec_k}: the draft loads for nothing — "
+                        "speculation stays off without spec_k >= 1"))
+        elif kind != "greedy":
+            add(Finding("info", "spec_k",
+                        f"speculation under serve_gen_sample = {kind} "
+                        "uses rejection sampling off the verified "
+                        "distribution — the output law matches plain "
+                        "sampling but the token stream is not "
+                        "reproducible against a non-speculative run "
+                        "(greedy is bitwise-identical; doc/serve.md)"))
+    chunk = _as_int(last, "decode_prefill_chunk", 0)
+    if chunk and eff_seqlen and eff_seqlen % chunk:
+        add(Finding("warn", "decode_prefill_chunk",
+                    f"decode_prefill_chunk = {chunk} does not divide "
+                    f"the cache length ({eff_seqlen}): the last chunk "
+                    "of a full-length prompt pads dead columns — pick "
+                    "a divisor to keep every chunk dispatch full"))
 
 
 def _mesh_rules(last: Dict[str, str], layer_types: List[str],

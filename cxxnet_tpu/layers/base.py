@@ -193,17 +193,30 @@ class DecodeState:
       ``arange(max_seqlen) <= positions``, which zeroes every not-yet-
       written slot exactly (softmax of ``NEG_INF`` underflows to 0.0),
       making the reduction bitwise equal to the full-forward one at f32.
+    * ``"block"`` — the multi-column generalization of ``"step"``
+      (speculative verify / chunked prefill, serve/decode.py): the
+      forward runs ``W`` consecutive positions per row starting at
+      ``positions``; attention layers scatter all ``W`` fresh (k, v)
+      columns at ``positions + arange(W)`` (out-of-range columns drop)
+      and query ``w`` attends under ``arange(max_seqlen) <= positions +
+      w`` — causal within the block, length-masked against the cache —
+      so each of the ``W`` logits rows is bitwise equal to the
+      sequential ``"step"`` row at the same position.
 
     ``caches`` maps the attention connection's decode key (stamped by
     the engine) to ``{"k": (rows, heads, max_seqlen, head_dim),
     "v": ...}`` arrays; layers write updated arrays back in place of
     the old ones so the engine can return them as donated outputs.
+    The cache arrays may be a narrower dtype than the activations
+    (``decode_kv_dtype = bf16``): layers cast on write, and the score /
+    p·V reductions accumulate in f32 as before.
     """
 
-    mode: str                               # "prefill" | "step"
+    mode: str                               # "prefill" | "step" | "block"
     caches: Dict[str, Dict[str, jnp.ndarray]]
-    # (rows,) int32 — step mode: the position being written (= number of
-    # tokens already in the cache); prefill mode: unused (None)
+    # (rows,) int32 — step/block mode: the (first) position being
+    # written (= number of tokens already in the cache); prefill mode:
+    # unused (None)
     positions: Optional[jnp.ndarray] = None
     max_seqlen: int = 0
 

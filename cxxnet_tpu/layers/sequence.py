@@ -140,16 +140,20 @@ class EmbeddingLayer(Layer):
         if "wpos" in params:
             dec = getattr(ctx, "decode", None)
             pos = _label_field(ctx, self.pos_key)
-            if dec is not None and dec.mode == "step":
-                # single-token decode (serve/decode.py): every row sits
-                # at its own absolute position — gather one positional
-                # row per batch element.  Identical arithmetic to the
-                # sequential broadcast's row at that position, so the
-                # incremental forward stays bitwise equal to the full one
-                pidx = jnp.clip(dec.positions.astype(jnp.int32), 0,
+            if dec is not None and dec.mode in ("step", "block"):
+                # incremental decode (serve/decode.py): every row sits
+                # at its own absolute position (step: one position;
+                # block: W consecutive positions starting there) —
+                # gather the positional rows per batch element.
+                # Identical arithmetic to the sequential broadcast's row
+                # at that position, so the incremental forward stays
+                # bitwise equal to the full one
+                pidx = jnp.clip(dec.positions.astype(jnp.int32)[:, None]
+                                + jnp.arange(ids.shape[1], dtype=jnp.int32)
+                                [None, :], 0,
                                 params["wpos"].shape[0] - 1)
                 out = out + jnp.take(params["wpos"], pidx,
-                                     axis=0)[:, None, :].astype(out.dtype)
+                                     axis=0).astype(out.dtype)
             elif pos is not None:
                 # packed documents: positions reset at each doc start —
                 # gather per (b, s) position ids instead of broadcasting
@@ -387,30 +391,61 @@ class AttentionLayer(Layer):
         softmax to exactly 0.0, and contribute nothing to the p·V
         reduction — which is how the incremental logits stay bitwise
         equal to the full forward at f32 even though never-written cache
-        slots hold stale (finite) garbage.
+        slots hold stale (finite) garbage.  Block mode is step mode over
+        ``W`` consecutive positions (speculative verify / chunked
+        prefill): scatter all ``W`` columns, and query ``w``'s mask is
+        ``arange(S) <= position + w`` — so row ``w``'s reduction is the
+        sequential step's at that position, bitwise.
         """
         key = getattr(self, "_decode_key", None)
         assert key is not None, \
             "attention: decode forward without an engine-stamped cache key"
         assert self.causal, "incremental decode requires causal = 1"
-        if dec.mode != "step":
+        if dec.mode not in ("step", "block"):
             dec.caches[key] = {"k": k, "v": v}
             return _single_device_attention(q, k, v, True, seg=None)
         b, h, s, hd = q.shape
-        assert s == 1, f"decode step expects seq len 1, got {s}"
+        if dec.mode == "step":
+            assert s == 1, f"decode step expects seq len 1, got {s}"
         cache = dec.caches[key]
         rows = jnp.arange(b)
-        # advanced indices at dims 0 and 2 with a slice between: the
-        # broadcast (b,) x (b,) pair leads the result, giving (b, h, hd)
-        # update slots — exactly k[:, :, 0, :]'s shape
-        ck = cache["k"].at[rows, :, dec.positions].set(k[:, :, 0, :])
-        cv = cache["v"].at[rows, :, dec.positions].set(v[:, :, 0, :])
+        if dec.mode == "step":
+            # advanced indices at dims 0 and 2 with a slice between: the
+            # broadcast (b,) x (b,) pair leads the result, giving
+            # (b, h, hd) update slots — exactly k[:, :, 0, :]'s shape
+            ck = cache["k"].at[rows, :, dec.positions].set(
+                k[:, :, 0, :].astype(cache["k"].dtype))
+            cv = cache["v"].at[rows, :, dec.positions].set(
+                v[:, :, 0, :].astype(cache["v"].dtype))
+            # query w = 0 sees columns <= positions
+            qoff = jnp.zeros((1,), jnp.int32)
+        else:
+            # block mode: W consecutive columns per row.  The (b, 1) x
+            # (b, W) advanced-index pair broadcasts to (b, W) and leads
+            # the result, so updates are (b, W, h, hd) — k transposed.
+            # ``mode="drop"`` discards columns past the cache end (a
+            # slot near its length limit verifies a block whose tail
+            # the scheduler never emits from)
+            idx = dec.positions[:, None] + jnp.arange(s)[None, :]
+            ck = cache["k"].at[rows[:, None], :, idx].set(
+                k.transpose(0, 2, 1, 3).astype(cache["k"].dtype),
+                mode="drop")
+            cv = cache["v"].at[rows[:, None], :, idx].set(
+                v.transpose(0, 2, 1, 3).astype(cache["v"].dtype),
+                mode="drop")
+            # query w sees columns <= positions + w: causal within the
+            # block, length-masked against the cache — each row's
+            # reduction is bitwise the sequential step's at that
+            # position
+            qoff = jnp.arange(s, dtype=jnp.int32)
         dec.caches[key] = {"k": ck, "v": cv}
         scale = 1.0 / (hd ** 0.5)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q,
+                            ck.astype(q.dtype),
                             preferred_element_type=jnp.float32) * scale
-        mask = jnp.arange(ck.shape[2])[None, :] <= dec.positions[:, None]
-        scores = jnp.where(mask[:, None, None, :], scores, ring.NEG_INF)
+        mask = jnp.arange(ck.shape[2])[None, None, :] \
+            <= (dec.positions[:, None] + qoff[None, :])[:, :, None]
+        scores = jnp.where(mask[:, None, :, :], scores, ring.NEG_INF)
         p = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p,
                           cv.astype(p.dtype)).astype(q.dtype)

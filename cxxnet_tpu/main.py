@@ -1386,9 +1386,24 @@ class LearnTask:
         emits per-token + per-request ``latency`` records and one
         ``serve_gen`` record (tokens/sec, occupancy histogram, retrace
         count — the telemetry ``bench.py --lm-serve`` sweeps)."""
-        from .serve.host import GenModel, ModelHost
+        from .serve.host import GenModel, ModelHost, load_draft_trainer
         metrics = self.net.metrics
-        gm = GenModel(self.net, cfg, metrics=metrics)
+        draft = None
+        if cfg.spec_k >= 1 and not cfg.draft_model:
+            raise ValueError(
+                f"spec_k = {cfg.spec_k} without serve_draft_model: "
+                "speculation needs a draft snapshot (doc/serve.md)")
+        if cfg.draft_model:
+            if cfg.spec_k >= 1:
+                mlog.notice(
+                    f"serve: loading draft model {cfg.draft_model} "
+                    f"(speculative decoding, spec_k = {cfg.spec_k})")
+                draft = load_draft_trainer(self.cfg, cfg.draft_model)
+            else:
+                mlog.warn("serve: serve_draft_model set but spec_k = 0 "
+                          "— speculation stays off")
+        gm = GenModel(self.net, cfg, draft_trainer=draft,
+                      metrics=metrics)
         # admin plane (serve/admin.py): same lifecycle as task_serve —
         # endpoint up before warmup (503 /readyz through compilation),
         # ready only once both decode executables are pinned.  The
@@ -1402,9 +1417,12 @@ class LearnTask:
             import dataclasses as _dc
             admin = host.start_admin(metrics, port=cfg.admin_port,
                                      config=_dc.asdict(cfg))
+        n_exec = 2 + len(gm.engine.block_widths) \
+            + (2 if gm.draft is not None else 0)
         mlog.notice(
             f"serve: warming decode engine ({cfg.slots} slot(s), "
-            f"max_seqlen {gm.engine.max_seqlen}, 2 executables) ...")
+            f"max_seqlen {gm.engine.max_seqlen}, {n_exec} "
+            "executables) ...")
         gm.warmup()
         mlog.info(f"serve: decode warmup compiled in "
                   f"{gm.engine.warmup_sec:.1f} sec")
@@ -1520,14 +1538,19 @@ class LearnTask:
                     **({"footprint": footprint} if footprint else {}))
             if gm.retraces:
                 mlog.warn(f"serve: {gm.retraces} decode retrace(s) past "
-                          "warmup — a shape escaped the two pinned "
-                          "executables (engine bug)")
+                          "warmup — a shape escaped the pinned "
+                          "executable set (engine bug)")
+            spec_note = (
+                f", acceptance {stats['acceptance_rate']:.0%} over "
+                f"{stats['verify_calls']} verify dispatch(es)"
+                if "acceptance_rate" in stats else "")
             mlog.result(
                 f"serve: generated {stats['tokens']} tokens for "
                 f"{n_total[0]} requests in {dur:.2f} sec "
                 f"({tps:.1f} tok/s, mean occupancy "
                 f"{stats['mean_occupancy']}, "
-                f"{stats['batching']} batching), retraces {gm.retraces}")
+                f"{stats['batching']} batching{spec_note}), "
+                f"retraces {gm.retraces}")
         finally:
             host.close()   # not-ready first, scheduler drain, admin join
         mlog.notice(f"finished serving, wrote {self.name_pred}")

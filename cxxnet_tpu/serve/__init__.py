@@ -122,6 +122,23 @@ SERVE_KEYS = (
       help="continuous = requests join/leave the decode batch between "
            "steps; request = fill a batch and run it to completion "
            "(the A/B baseline)"),
+    # -- speculative decoding + chunked prefill (doc/serve.md)
+    K("serve_draft_model", "path",
+      help="snapshot of the small DRAFT net for speculative decoding "
+           "(loaded through the load_serve_model path; same vocab and "
+           "decode_max_seqlen as the flagship)"),
+    K("spec_k", "int", lo=0,
+      help="draft tokens proposed per speculative round; the flagship "
+           "verifies all spec_k+1 positions in ONE block dispatch "
+           "(0 = speculation off; requires serve_draft_model)"),
+    K("decode_prefill_chunk", "int", lo=0,
+      help="chunked prefill: stream the prompt into the KV cache this "
+           "many columns per dispatch, interleaved between decode "
+           "rounds (0 = whole-prompt prefill)"),
+    K("decode_kv_dtype", "enum", choices=("f32", "bf16"),
+      help="KV-cache storage dtype: bf16 halves the dominant serve "
+           "memory term (cast on write, f32 accumulation on read; "
+           "pairtested within SERVE_TOL)"),
     # -- live control plane (serve/admin.py, doc/serve.md "Operating a
     #    serve host")
     K("serve_admin_port", "int", lo=0, hi=65535,
@@ -180,6 +197,11 @@ class ServeConfig:
     gen_eos: int = -1
     gen_prompt: int = 8
     gen_batching: str = "continuous"
+    # speculative decoding + chunked prefill (serve/batcher.py)
+    draft_model: str = ""       # draft-net snapshot; "" = no speculation
+    spec_k: int = 0             # proposals per round; 0 = speculation off
+    prefill_chunk: int = 0      # 0 = whole-prompt prefill
+    kv_dtype: str = ""          # "" = f32 (or bf16 when the net is bf16)
     # live control plane (serve/admin.py) + SLO (monitor/slo.py)
     admin_port: int = 0         # 0 = no admin endpoint
     slo_p99_ms: float = 0.0     # 0 = no SLO
@@ -219,6 +241,16 @@ class ServeConfig:
         if self.gen_sample == "topk" and self.gen_topk < 1:
             raise ValueError(
                 "serve_gen_sample = topk requires serve_gen_topk >= 1")
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k = {self.spec_k}: must be >= 0")
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"decode_prefill_chunk = {self.prefill_chunk}: must "
+                "be >= 0 (0 = whole-prompt prefill)")
+        if self.kv_dtype not in ("", "f32", "bf16"):
+            raise ValueError(
+                f"decode_kv_dtype = {self.kv_dtype!r}: expected f32 "
+                "or bf16")
         if not 0 <= self.admin_port <= 65535:
             raise ValueError(
                 f"serve_admin_port = {self.admin_port}: expected "
@@ -236,7 +268,8 @@ class ServeConfig:
         """Build from ordered config pairs (last occurrence wins, like
         every ``set_param`` consumer)."""
         last = {k: v for k, v in pairs
-                if k.startswith("serve_") or k.startswith("decode_")}
+                if k.startswith("serve_") or k.startswith("decode_")
+                or k == "spec_k"}
         kw = {}
         if "serve_shapes" in last:
             kw["shapes"] = tuple(parse_shapes(last["serve_shapes"]))
@@ -261,6 +294,12 @@ class ServeConfig:
                                  ("serve_gen_prompt", "gen_prompt", int),
                                  ("serve_gen_batching",
                                   "gen_batching", str),
+                                 ("serve_draft_model", "draft_model",
+                                  str),
+                                 ("spec_k", "spec_k", int),
+                                 ("decode_prefill_chunk",
+                                  "prefill_chunk", int),
+                                 ("decode_kv_dtype", "kv_dtype", str),
                                  ("serve_admin_port", "admin_port", int),
                                  ("serve_slo_p99_ms", "slo_p99_ms",
                                   float),

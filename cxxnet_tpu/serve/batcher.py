@@ -378,6 +378,16 @@ class _GenRequest:
     rng: Optional[Any] = None
     tokens: Optional[list] = None       # generated ids (the result)
     pos: int = 0                        # next cache write position
+    # speculative decoding: columns valid in the DRAFT cache.  Trails
+    # ``pos`` by at most 1 (only after a fully accepted block — the
+    # draft never consumed its own last proposal); the catch-up tick at
+    # the top of each round closes the gap.  Rollback after a rejected
+    # tail is just this counter: the length mask hides stale columns,
+    # no buffer copy
+    dpos: int = 0
+    # chunked prefill: next chunk offset while the prompt streams into
+    # the cache decode_prefill_chunk columns at a time
+    chunk_off: int = 0
     error: Optional[BaseException] = None
     trace_id: Optional[int] = None
     tid: Optional[str] = None
@@ -400,6 +410,25 @@ class StepScheduler:
     completion) — the A/B baseline ``bench.py --lm-serve`` measures
     against.
 
+    Speculative decoding (``draft`` + ``spec_k``, doc/serve.md): each
+    decode round runs ``spec_k`` cheap single-token steps on the DRAFT
+    runner to propose a candidate block, then ONE flagship ``block``
+    dispatch verifies all ``spec_k + 1`` positions against the flagship
+    cache.  The accepted prefix advances the cache several columns per
+    flagship dispatch; a rejected tail rolls both caches back by
+    arithmetic on the length counters (the mask hides stale columns —
+    no buffer copy).  Greedy speculative output is BITWISE identical to
+    plain greedy decode (every verify row is the sequential step's
+    logits row); non-greedy sampling uses standard rejection sampling
+    off the verified distributions, which preserves the target
+    distribution exactly.
+
+    Chunked prefill (``prefill_chunk``): instead of one whole-prompt
+    prefill stalling every in-flight request's next token, the prompt
+    streams into the cache ``prefill_chunk`` columns per ``block``
+    dispatch, ONE chunk tick interleaved between decode rounds —
+    bounding head-of-line blocking at one chunk.
+
     Thread discipline is MicroBatcher's verbatim: bounded queue,
     ``None`` shutdown sentinel, a runner exception latches the
     scheduler dead and fans out to every active AND queued request —
@@ -409,6 +438,7 @@ class StepScheduler:
                  eos: int = -1, sample: str = "greedy",
                  temp: float = 1.0, topk: int = 0, seed: int = 0,
                  queue_depth: int = 64, continuous: bool = True,
+                 draft=None, spec_k: int = 0, prefill_chunk: int = 0,
                  metrics=None, name: str = "decode"):
         self.runner = runner
         self.max_new_tokens = max(1, int(max_new_tokens))
@@ -418,6 +448,10 @@ class StepScheduler:
         self.topk = int(topk)
         self.seed = int(seed)
         self.continuous = bool(continuous)
+        self.draft = draft
+        self.spec_k = int(spec_k)
+        self.prefill_chunk = int(prefill_chunk)
+        self._spec = draft is not None and self.spec_k >= 1
         self.metrics = metrics
         self.name = name
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_depth)))
@@ -426,6 +460,9 @@ class StepScheduler:
         self._closing = False
         self._draining = False
         self._active: Dict[int, _GenRequest] = {}
+        # slots mid-chunked-prefill (FIFO by admission: _fill_order)
+        self._filling: Dict[int, _GenRequest] = {}
+        self._fill_order: list = []
         self._free: list = list(range(runner.slots))
         self._req_seq = 0
         # accounting for the serve_gen record / --lm-serve sweep
@@ -433,6 +470,13 @@ class StepScheduler:
         self.n_tokens = 0
         self.n_steps = 0
         self.n_prefills = 0
+        self.n_prefill_chunks = 0
+        self.n_draft_steps = 0
+        self.n_verify_calls = 0
+        self.n_spec_proposed = 0
+        self.n_spec_accepted = 0
+        self._draft_wall = 0.0
+        self._verify_wall = 0.0
         self.occ_hist: Dict[int, int] = {}
         self._tok_lats: list = []       # per-step decode+sample wall
         self._stats_lock = threading.Lock()
@@ -509,7 +553,7 @@ class StepScheduler:
     def _loop(self) -> None:
         batch_open = True   # request-level mode: admission window —
         while True:         # open while the batch has not stepped yet
-            if not self._active:
+            if not self._active and not self._filling:
                 if self._draining:
                     return
                 batch_open = True
@@ -534,10 +578,18 @@ class StepScheduler:
                     break
                 if not self._admit(r):
                     return
+            # chunked prefill: ONE chunk tick per loop iteration for
+            # the oldest joining prompt, interleaved with the decode
+            # round below — a long prompt costs every in-flight request
+            # at most one chunk of head-of-line latency per token
+            if self._filling:
+                if not self._chunk_tick():
+                    return
             if not self._active:
                 continue
             batch_open = False
-            if not self._step_once():
+            if not (self._spec_round() if self._spec
+                    else self._step_once()):
                 return
 
     def _sample(self, logits, req: _GenRequest) -> int:
@@ -552,10 +604,19 @@ class StepScheduler:
         req.event.set()
 
     def _admit(self, req: _GenRequest) -> bool:
-        """Prefill ``req`` into a free slot; False latches the
-        scheduler dead (exception already fanned out)."""
+        """Prefill ``req`` into a free slot (or queue it for chunked
+        prefill); False latches the scheduler dead (exception already
+        fanned out)."""
         tracer = self.metrics.tracer if self.metrics is not None else None
         slot = self._free.pop()
+        if self.prefill_chunk > 0:
+            # chunked admission: the prompt streams into the cache one
+            # _chunk_tick at a time; the request activates (samples its
+            # first token) on the last chunk
+            req.chunk_off = 0
+            self._filling[slot] = req
+            self._fill_order.append(slot)
+            return True
         try:
             t0 = time.perf_counter()
             logits = self.runner.prefill(slot, req.prompt)
@@ -565,22 +626,97 @@ class StepScheduler:
                             slot=slot, prompt=int(req.prompt.shape[0]),
                             model=self.name)
             self.n_prefills += 1
-            tok = self._sample(logits, req)
-            req.tokens = [tok]
-            req.pos = int(req.prompt.shape[0])
-            self.n_tokens += 1
-            limit = getattr(self.runner, "max_seqlen", None)
-            if tok == self.eos or len(req.tokens) >= req.max_new \
-                    or (limit is not None and req.pos >= limit):
-                self._free.append(slot)
-                self.n_requests += 1
-                req.event.set()
-            else:
-                self._active[slot] = req
+            self._activate(slot, req, logits)
             return True
         except BaseException as e:  # noqa: BLE001 — must reach clients
             self._free.append(slot)
             self._fail(e, extra=[req])
+            return False
+
+    def _activate(self, slot: int, req: _GenRequest, logits) -> None:
+        """The prompt is fully cached: prefill the draft (speculation),
+        sample the first token off the last-prompt-position ``logits``
+        row, and move ``req`` into the active batch (or finish it).
+        Caller owns exception handling — a draft prefill failure latches
+        like a flagship one."""
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        plen = int(req.prompt.shape[0])
+        if self._spec:
+            t0 = time.perf_counter()
+            self.draft.prefill(slot, req.prompt)
+            t1 = time.perf_counter()
+            self._draft_wall += t1 - t0
+            if req.trace_id is not None and tracer is not None:
+                tracer.emit("draft", t0, t1, trace_id=req.trace_id,
+                            slot=slot, prompt=plen, model=self.name)
+        req.dpos = plen
+        tok = self._sample(logits, req)
+        req.tokens = [tok]
+        req.pos = plen
+        self.n_tokens += 1
+        limit = getattr(self.runner, "max_seqlen", None)
+        if tok == self.eos or len(req.tokens) >= req.max_new \
+                or (limit is not None and req.pos >= limit):
+            self._free.append(slot)
+            self.n_requests += 1
+            req.event.set()
+        else:
+            self._active[slot] = req
+
+    def _base_positions(self) -> "np.ndarray":
+        """Per-slot next-write FLAGSHIP cache column — the sacrificial
+        position an idle slot passes in a batched dispatch: garbage
+        scattered there sits past the slot's length mask and is
+        overwritten by the dispatch that first computes at it, so it is
+        never read (the property every batched multi-slot dispatch
+        leans on)."""
+        positions = np.zeros((self.runner.slots,), np.int32)
+        for slot, req in self._active.items():
+            positions[slot] = req.pos
+        for slot, req in self._filling.items():
+            positions[slot] = req.chunk_off
+        return positions
+
+    def _chunk_tick(self) -> bool:
+        """One chunked-prefill dispatch: the next ``prefill_chunk``
+        prompt columns of the OLDEST joining request (FIFO), every
+        other slot sacrificial.  On the last chunk the request
+        activates.  False latches the scheduler dead."""
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        slot = self._fill_order[0]
+        req = self._filling[slot]
+        C = self.prefill_chunk
+        off = req.chunk_off
+        plen = int(req.prompt.shape[0])
+        tokens = np.zeros((self.runner.slots, C), np.int32)
+        positions = self._base_positions()
+        chunk = req.prompt[off:off + C]
+        tokens[slot, :chunk.shape[0]] = chunk
+        positions[slot] = off
+        try:
+            t0 = time.perf_counter()
+            logits = self.runner.block(tokens, positions)
+            t1 = time.perf_counter()
+            self.n_prefill_chunks += 1
+            if req.trace_id is not None and tracer is not None:
+                tracer.emit("prefill_chunk", t0, t1,
+                            trace_id=req.trace_id, slot=slot,
+                            offset=off, model=self.name)
+            req.chunk_off = off + C
+            if req.chunk_off >= plen:
+                self._fill_order.pop(0)
+                del self._filling[slot]
+                self.n_prefills += 1
+                # the last prompt position's logits row — same row the
+                # whole-prompt prefill returns, bitwise (block rows are
+                # the sequential steps' rows)
+                self._activate(slot, req, logits[slot, plen - 1 - off])
+            return True
+        except BaseException as e:  # noqa: BLE001 — must reach clients
+            # req may already be out of _filling (activation threw):
+            # make sure it fails either way; _fail covers _filling
+            extra = [] if req.event.is_set() else [req]
+            self._fail(e, extra=extra)
             return False
 
     def _step_once(self) -> bool:
@@ -633,14 +769,188 @@ class StepScheduler:
             self._fail(e)
             return False
 
+    def _draft_positions(self) -> "np.ndarray":
+        """Per-slot next-write DRAFT cache column.  Idle slots are
+        sacrificial at 0 — a filling/free slot's draft row is fully
+        rewritten by its whole-prompt draft prefill at activation."""
+        positions = np.zeros((self.runner.slots,), np.int32)
+        for slot, req in self._active.items():
+            positions[slot] = req.dpos
+        return positions
+
+    def _spec_round(self) -> bool:
+        """One speculative decode round over every active slot: (1) a
+        draft catch-up tick for slots whose draft cache trails the
+        flagship by one column (the fully-accepted-block case — the
+        draft never fed its own last proposal), (2) ``spec_k`` draft
+        steps proposing a candidate block, (3) ONE flagship ``block``
+        dispatch verifying all ``spec_k + 1`` positions against the
+        flagship cache, (4) host-side acceptance — greedy takes the
+        longest argmax-agreeing prefix, which makes speculative greedy
+        output BITWISE identical to plain greedy decode (every verify
+        row is the sequential step's logits row); non-greedy does
+        standard rejection sampling off the verified distributions.
+        Rejected tails roll both caches back by arithmetic on the
+        length counters (``pos``/``dpos``) — the length mask hides the
+        stale columns, no buffer copy.  False latches the scheduler
+        dead."""
+        from .decode import draw_from, sample_probs
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        riders = [r.trace_id for r in self._active.values()
+                  if r.trace_id is not None] \
+            if tracer is not None and tracer.enabled else []
+        slots = self.runner.slots
+        k = self.spec_k
+        greedy = self.sample_kind == "greedy"
+        n_active = len(self._active)
+        round_draft_steps = 0
+        try:
+            t0 = time.perf_counter()
+            # --- (1) catch-up: feed the true token at the draft's next
+            # column; non-lagging slots ride sacrificially (their own
+            # next column — overwritten by the first proposal step)
+            if any(req.dpos < req.pos for req in self._active.values()):
+                tokens = np.zeros((slots,), np.int32)
+                positions = self._draft_positions()
+                for slot, req in self._active.items():
+                    if req.dpos < req.pos:
+                        plen = int(req.prompt.shape[0])
+                        tokens[slot] = req.tokens[req.dpos - plen]
+                self.draft.step(tokens, positions)
+                round_draft_steps += 1
+                for req in self._active.values():
+                    if req.dpos < req.pos:
+                        req.dpos += 1
+            # --- (2) spec_k proposal steps: the draft feeds the pending
+            # token first, then chains its own proposals
+            props = np.zeros((slots, k), np.int32)
+            dprobs: Dict = {}           # (slot, j) -> draft prob vector
+            feed = np.zeros((slots,), np.int32)
+            for slot, req in self._active.items():
+                feed[slot] = req.tokens[-1]
+            for j in range(k):
+                positions = self._draft_positions()
+                logits = self.draft.step(feed, positions)
+                round_draft_steps += 1
+                for slot, req in self._active.items():
+                    if greedy:
+                        d = int(np.argmax(logits[slot]))
+                    else:
+                        p = sample_probs(logits[slot], self.sample_kind,
+                                         self.temp, self.topk)
+                        d = draw_from(p, req.rng)
+                        dprobs[(slot, j)] = p
+                    props[slot, j] = d
+                    feed[slot] = d
+                    req.dpos += 1
+            t1 = time.perf_counter()
+            self._draft_wall += t1 - t0
+            # --- (3) verify: pending token + the k proposals, ONE
+            # flagship dispatch over all slots
+            vtokens = np.zeros((slots, k + 1), np.int32)
+            vpos = self._base_positions()
+            for slot, req in self._active.items():
+                vtokens[slot, 0] = req.tokens[-1]
+                vtokens[slot, 1:] = props[slot]
+            if riders:
+                with tracer.link(riders):
+                    logits = self.runner.block(vtokens, vpos)
+            else:
+                logits = self.runner.block(vtokens, vpos)
+            self.n_verify_calls += 1
+            t2 = time.perf_counter()
+            self._verify_wall += t2 - t1
+            # --- (4) acceptance + emission
+            limit = getattr(self.runner, "max_seqlen", None)
+            for slot in list(self._active):
+                req = self._active[slot]
+                emitted = []
+                if greedy:
+                    # longest prefix where the draft agrees with the
+                    # verified argmax; the first disagreeing position
+                    # emits the VERIFIED token (so even a 0-acceptance
+                    # draft leaves the output stream bitwise greedy)
+                    for i in range(k + 1):
+                        g = int(np.argmax(logits[slot, i]))
+                        emitted.append(g)
+                        if i < k and props[slot, i] != g:
+                            break
+                else:
+                    for i in range(k):
+                        pt = sample_probs(logits[slot, i],
+                                          self.sample_kind, self.temp,
+                                          self.topk)
+                        pd = dprobs[(slot, i)]
+                        d = int(props[slot, i])
+                        if req.rng.random_sample() * pd[d] < pt[d]:
+                            emitted.append(d)
+                            continue
+                        res = np.maximum(pt - pd, 0.0)
+                        tot = res.sum()
+                        emitted.append(
+                            draw_from(res / tot, req.rng) if tot > 0.0
+                            else draw_from(pt, req.rng))
+                        break
+                    else:
+                        pt = sample_probs(logits[slot, k],
+                                          self.sample_kind, self.temp,
+                                          self.topk)
+                        emitted.append(draw_from(pt, req.rng))
+                m = len(emitted) - 1        # proposals accepted
+                self.n_spec_proposed += k
+                self.n_spec_accepted += m
+                # draft rollback is counter arithmetic: lag 1 only
+                # after a fully accepted block (m == k)
+                req.dpos = req.pos + 1 + min(m, k - 1)
+                for tok in emitted:
+                    req.tokens.append(tok)
+                    req.pos += 1
+                    self.n_tokens += 1
+                    if tok == self.eos \
+                            or len(req.tokens) >= req.max_new \
+                            or (limit is not None and req.pos >= limit):
+                        self._finish(slot, req)
+                        break
+            t3 = time.perf_counter()
+            if riders:
+                tracer.emit("draft", t0, t1, riders=riders,
+                            active=n_active, model=self.name)
+                tracer.emit("verify", t1, t2, riders=riders,
+                            active=n_active, model=self.name)
+                tracer.emit("sample", t2, t3, riders=riders,
+                            active=n_active, model=self.name)
+            self.n_steps += 1
+            self.n_draft_steps += round_draft_steps
+            self.occ_hist[n_active] = self.occ_hist.get(n_active, 0) + 1
+            step_wall = t3 - t0
+            with self._stats_lock:
+                self._tok_lats.append(step_wall)
+            if self.metrics is not None:
+                self.metrics.observe("token_latency_sec", step_wall)
+                self.metrics.counter_inc("spec_draft_steps",
+                                         round_draft_steps)
+                self.metrics.counter_inc("spec_verify_calls")
+                if self.n_spec_proposed:
+                    self.metrics.set_gauge(
+                        "spec_accept_rate",
+                        self.n_spec_accepted / self.n_spec_proposed)
+            return True
+        except BaseException as e:  # noqa: BLE001 — must reach clients
+            self._fail(e)
+            return False
+
     def _fail(self, e: BaseException, extra=()) -> None:
-        """Latch dead and fan the exception out to every active AND
-        queued request (the MicroBatcher _run contract)."""
+        """Latch dead and fan the exception out to every active,
+        chunk-prefilling, AND queued request (the MicroBatcher _run
+        contract)."""
         self._failed = e
-        for req in list(self._active.values()) + list(extra):
+        for req in (list(self._active.values())
+                    + list(self._filling.values()) + list(extra)):
             req.error = e
             req.event.set()
         self._active.clear()
+        self._filling.clear()
+        self._fill_order.clear()
         self._gen_drain(e)
 
     def _gen_drain(self, err: Optional[BaseException]) -> None:
@@ -692,6 +1002,19 @@ class StepScheduler:
                                in sorted(self.occ_hist.items())},
             "batching": "continuous" if self.continuous else "request",
         }
+        if self._spec:
+            out.update(
+                spec_k=self.spec_k,
+                draft_steps=self.n_draft_steps,
+                verify_calls=self.n_verify_calls,
+                acceptance_rate=round(
+                    self.n_spec_accepted / self.n_spec_proposed, 4)
+                if self.n_spec_proposed else 0.0,
+                draft_ms=round(self._draft_wall * 1e3, 3),
+                verify_ms=round(self._verify_wall * 1e3, 3))
+        if self.prefill_chunk > 0:
+            out.update(prefill_chunk=self.prefill_chunk,
+                       prefill_chunks=self.n_prefill_chunks)
         if lats:
             from ..monitor.metrics import nearest_rank
             out.update(

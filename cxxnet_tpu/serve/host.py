@@ -89,34 +89,72 @@ class GenModel:
     surface, ``generate`` instead of ``predict``."""
 
     def __init__(self, trainer, cfg: Optional[ServeConfig] = None, *,
-                 metrics=None, name: str = "default"):
+                 draft_trainer=None, metrics=None,
+                 name: str = "default"):
         from .batcher import StepScheduler
         from .decode import DecodeEngine
         self.name = name
         self.cfg = cfg or ServeConfig(gen=1)
         self.trainer = trainer
         self.metrics = metrics if metrics is not None else trainer.metrics
+        spec = draft_trainer is not None and self.cfg.spec_k >= 1
+        # block executables this model needs warmed: the speculative
+        # verify width (spec_k + 1) and the chunked-prefill width
+        widths = []
+        if spec:
+            widths.append(self.cfg.spec_k + 1)
+        if self.cfg.prefill_chunk > 0:
+            widths.append(self.cfg.prefill_chunk)
         self.engine = DecodeEngine(trainer, slots=self.cfg.slots,
                                    max_seqlen=self.cfg.max_seqlen,
-                                   metrics=self.metrics)
+                                   metrics=self.metrics,
+                                   kv_dtype=self.cfg.kv_dtype,
+                                   block_widths=widths)
+        self.draft = None
+        if spec:
+            # the draft shares slots + cache geometry so slot ids line
+            # up across both engines; vocab must agree or proposals are
+            # meaningless
+            self.draft = DecodeEngine(
+                draft_trainer, slots=self.cfg.slots,
+                max_seqlen=self.engine.max_seqlen,
+                metrics=self.metrics, kv_dtype=self.cfg.kv_dtype)
+            if self.draft.vocab != self.engine.vocab:
+                raise ValueError(
+                    f"serve_draft_model: draft vocab {self.draft.vocab}"
+                    f" != flagship vocab {self.engine.vocab}")
+            if self.draft.max_seqlen != self.engine.max_seqlen:
+                raise ValueError(
+                    "serve_draft_model: draft max_seqlen "
+                    f"{self.draft.max_seqlen} != flagship "
+                    f"{self.engine.max_seqlen} (the draft net must be "
+                    "built at the flagship's decode width)")
         self.scheduler = StepScheduler(
             self.engine, max_new_tokens=self.cfg.gen_tokens,
             eos=self.cfg.gen_eos, sample=self.cfg.gen_sample,
             temp=self.cfg.gen_temp, topk=self.cfg.gen_topk,
             seed=self.cfg.gen_seed, queue_depth=self.cfg.queue_depth,
             continuous=self.cfg.gen_batching == "continuous",
+            draft=self.draft, spec_k=self.cfg.spec_k,
+            prefill_chunk=self.cfg.prefill_chunk,
             metrics=self.metrics, name=name)
 
     def warmup(self) -> None:
-        """Compile both decode executables and start the scheduler;
-        after this, generation never traces (``retraces`` stays 0)."""
+        """Compile the full decode executable set (flagship prefill /
+        step / block widths, plus the draft's prefill / step) and start
+        the scheduler; after this, generation never traces
+        (``retraces`` stays 0)."""
         tracer = self.metrics.tracer if self.metrics is not None else None
         if tracer is not None and tracer.enabled:
             with tracer.span("decode_warmup", model=self.name,
                              slots=self.engine.slots):
                 self.engine.warmup()
+                if self.draft is not None:
+                    self.draft.warmup()
         else:
             self.engine.warmup()
+            if self.draft is not None:
+                self.draft.warmup()
         self.scheduler.start()
 
     def generate(self, prompt: np.ndarray,
@@ -127,10 +165,20 @@ class GenModel:
 
     @property
     def retraces(self) -> int:
-        return self.engine.retraces
+        n = self.engine.retraces
+        if self.draft is not None:
+            n += self.draft.retraces
+        return n
 
     def footprint(self) -> Dict[str, int]:
-        return self.engine.footprint()
+        fp = self.engine.footprint()
+        if self.draft is not None and fp:
+            dfp = self.draft.footprint()
+            fp = dict(fp)
+            fp["draft_bytes"] = dfp.get("total_bytes", 0)
+            fp["total_bytes"] = fp.get("total_bytes", 0) \
+                + fp["draft_bytes"]
+        return fp
 
     def close(self) -> None:
         self.scheduler.close()
@@ -267,3 +315,18 @@ def load_serve_model(pairs: Sequence[Tuple[str, str]], *,
     if warmup:
         sm.warmup()
     return sm
+
+
+def load_draft_trainer(pairs: Sequence[Tuple[str, str]], path: str):
+    """Load the speculative DRAFT net's trainer from its own snapshot
+    (``serve_draft_model``), through the same path load_serve_model
+    uses: session pairs configure the trainer (batch_size / dev /
+    engine keys), the snapshot header restores the draft's OWN net
+    structure — so the flagship's ``netconfig`` section never leaks
+    into the draft."""
+    from ..nnet.trainer import NetTrainer
+    t = NetTrainer()
+    for k, v in pairs:
+        t.set_param(k, v)
+    t.load_model(path)
+    return t

@@ -153,6 +153,7 @@ class FakeRunner:
         self.fail_after = fail_after
         self.prefill_log = []            # (slot, prompt_len)
         self.step_actives = []           # tuple of active slots per step
+        self.block_log = []              # (width, positions) per block
         self.lock = threading.Lock()
 
     def _logits(self, slot):
@@ -174,6 +175,16 @@ class FakeRunner:
                 raise RuntimeError("device fell over")
         time.sleep(self.step_sleep)
         return np.stack([self._logits(s) for s in range(self.slots)])
+
+    def block(self, tokens, positions):
+        # multi-column dispatch (chunked prefill / speculative verify):
+        # every row repeats the slot's rigged logits
+        w = tokens.shape[1]
+        with self.lock:
+            self.block_log.append((w, tuple(int(p) for p in positions)))
+        time.sleep(self.step_sleep)
+        return np.stack([np.tile(self._logits(s), (w, 1))
+                         for s in range(self.slots)])
 
 
 def _submit_async(sched, prompt, max_new):
@@ -446,6 +457,444 @@ metrics_sink = jsonl:{tmp_path}/gen_metrics.jsonl
     # decode/sample spans fan out over the riders they stepped for
     riders = [r for r in spans if r["span"] in ("decode", "sample")]
     assert riders and all(r["riders"] for r in riders)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxxnet-decode")
+                or t.name.startswith("cxxnet-serve-gen")]
+
+
+# ------------------------------------- speculative decoding (ISSUE 19)
+# Contract under test: greedy speculative output is BITWISE identical
+# to plain greedy decode (np.array_equal), whatever the draft proposes
+# — every verify row of the block dispatch is the sequential step's
+# logits row, and the acceptance loop emits the VERIFIED token at the
+# first disagreement.  Chunked prefill rides the same block executable
+# and must land the same cache contents as whole-prompt prefill.
+
+@pytest.fixture(scope="module")
+def block_engine(lm_trainer):
+    """The flagship engine with block widths warmed for spec_k=3
+    verification (width 4) and chunk-8 prefill."""
+    eng = DecodeEngine(lm_trainer, slots=2, max_seqlen=32,
+                       block_widths=(4, 8))
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def draft_engine(lm_trainer):
+    """Degenerate draft: the SAME net as the flagship, so every
+    proposal agrees and acceptance is total."""
+    eng = DecodeEngine(lm_trainer, slots=2, max_seqlen=32)
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def small_draft_engine():
+    """A genuinely different (smaller, untrained) draft net — the
+    realistic partial/zero-agreement regime."""
+    from cxxnet_tpu.models import transformer
+    from __graft_entry__ import _make_trainer
+    t = _make_trainer(
+        transformer(vocab=64, seq=32, dim=16, nlayer=1, nhead=2),
+        2, "cpu", extra=[("updater", "sgd"), ("eta", "0.01"),
+                         ("eval_train", "0"), ("silent", "1")])
+    eng = DecodeEngine(t, slots=2, max_seqlen=32)
+    eng.warmup()
+    return eng
+
+
+class ShiftedDraft:
+    """Adversarial draft: the flagship's logits rolled one vocab slot,
+    so the greedy proposal NEVER matches the verified argmax — every
+    round rejects everything and rolls the caches back."""
+
+    def __init__(self, eng):
+        self.eng = eng
+        self.slots = eng.slots
+        self.max_seqlen = eng.max_seqlen
+        self.vocab = eng.vocab
+
+    def prefill(self, slot, tokens):
+        return np.roll(self.eng.prefill(slot, tokens), 1, axis=-1)
+
+    def step(self, tokens, positions):
+        return np.roll(self.eng.step(tokens, positions), 1, axis=-1)
+
+
+def _serial_greedy(engine, prompt, max_new):
+    """Plain greedy reference through the sequential step path."""
+    logits = engine.prefill(0, prompt)
+    seq = [int(np.argmax(logits))]
+    pos = len(prompt)
+    while len(seq) < max_new and pos < engine.max_seqlen:
+        step = engine.step(np.asarray([seq[-1], 0], np.int32),
+                           np.asarray([pos, 0], np.int32))
+        seq.append(int(np.argmax(step[0])))
+        pos += 1
+    return seq
+
+
+def _spec_generate(flagship, draft, prompts, max_new, **kw):
+    s = StepScheduler(flagship, max_new_tokens=max_new, eos=-1,
+                      queue_depth=8, draft=draft, **kw)
+    s.start()
+    try:
+        outs = [s.submit(p, max_new) for p in prompts]
+    finally:
+        s.close()
+    return outs, s
+
+
+def test_block_matches_sequential_steps_bitwise(block_engine):
+    """The multi-column cache advance: one width-4 block dispatch over
+    the tokens k sequential steps would feed produces the SAME four
+    logits rows, bitwise — each block row's mask stops at its own
+    position, so its reduction is the sequential step's."""
+    eng = block_engine
+    p = _prompt(9, seed=11)
+    logits = eng.prefill(0, p)
+    toks = [int(np.argmax(logits))]
+    rows = []
+    pos = len(p)
+    for i in range(4):
+        step = eng.step(np.asarray([toks[-1], 0], np.int32),
+                        np.asarray([pos + i, 0], np.int32))
+        rows.append(step[0])
+        toks.append(int(np.argmax(step[0])))
+    blk = eng.block(
+        np.asarray([toks[:4], [0, 0, 0, 0]], np.int32),
+        np.asarray([len(p), 0], np.int32))
+    for i in range(4):
+        assert np.array_equal(blk[0, i], rows[i]), f"row {i}"
+    assert eng.retraces == 0
+
+
+def test_spec_greedy_bitwise_degenerate_draft(engine, block_engine,
+                                              draft_engine):
+    """draft == flagship: every proposal is accepted (the full-accept /
+    draft-lag path runs every round) and the output is still bitwise
+    plain greedy."""
+    prompts = [_prompt(5, seed=1), _prompt(17, seed=2),
+               _prompt(29, seed=3)]
+    want = [_serial_greedy(engine, p, 12) for p in prompts]
+    got, s = _spec_generate(block_engine, draft_engine, prompts, 12,
+                            spec_k=3)
+    assert [list(g) for g in got] == want
+    assert s.n_spec_proposed > 0
+    assert s.n_spec_accepted == s.n_spec_proposed
+    # multi-column advance: far fewer flagship dispatches than tokens
+    assert s.n_verify_calls < sum(len(w) for w in want)
+    assert block_engine.retraces == 0
+
+
+def test_spec_greedy_bitwise_adversarial_draft(engine, block_engine,
+                                               draft_engine):
+    """Forced total disagreement: zero acceptance, every round rolls
+    both caches back (rollback-then-continue), and the output stream is
+    STILL bitwise plain greedy — the verified row at the first
+    disagreement is the sequential step's row."""
+    prompts = [_prompt(5, seed=1), _prompt(17, seed=2),
+               _prompt(29, seed=3)]
+    want = [_serial_greedy(engine, p, 12) for p in prompts]
+    got, s = _spec_generate(block_engine, ShiftedDraft(draft_engine),
+                            prompts, 12, spec_k=3)
+    assert [list(g) for g in got] == want
+    assert s.n_spec_accepted == 0 and s.n_spec_proposed > 0
+    # zero acceptance degrades to one emitted token per verify call
+    assert s.n_verify_calls == sum(len(w) for w in want) \
+        - len(prompts)  # first token of each request comes from prefill
+    assert block_engine.retraces == 0
+
+
+def test_spec_greedy_bitwise_real_draft(engine, block_engine,
+                                        small_draft_engine):
+    """A genuinely different draft net (partial agreement, whatever it
+    happens to be): parity must hold regardless of the acceptance
+    rate."""
+    prompts = [_prompt(5, seed=4), _prompt(13, seed=5),
+               _prompt(23, seed=6)]
+    want = [_serial_greedy(engine, p, 10) for p in prompts]
+    got, s = _spec_generate(block_engine, small_draft_engine, prompts,
+                            10, spec_k=3)
+    assert [list(g) for g in got] == want
+    st = s.stats()
+    assert st["spec_k"] == 3 and st["verify_calls"] == s.n_verify_calls
+    assert 0.0 <= st["acceptance_rate"] <= 1.0
+    assert st["draft_ms"] >= 0.0 and st["verify_ms"] >= 0.0
+
+
+def test_spec_composes_with_chunked_prefill(engine, block_engine,
+                                            draft_engine):
+    """Speculation x chunked prefill x continuous batching in one
+    scheduler: still bitwise greedy, chunk ticks counted, zero
+    retraces (both block widths were AOT-warmed)."""
+    prompts = [_prompt(5, seed=7), _prompt(17, seed=8),
+               _prompt(29, seed=9)]
+    want = [_serial_greedy(engine, p, 12) for p in prompts]
+    got, s = _spec_generate(block_engine, draft_engine, prompts, 12,
+                            spec_k=3, prefill_chunk=8)
+    assert [list(g) for g in got] == want
+    st = s.stats()
+    assert st["prefill_chunks"] == sum(
+        -(-len(p) // 8) for p in prompts)
+    assert st["prefills"] == len(prompts)
+    assert block_engine.retraces == 0
+    assert draft_engine.retraces == 0
+
+
+def test_chunked_prefill_logits_bitwise(block_engine):
+    """Chunked prefill streams the prompt through the width-8 block
+    executable; the last chunk's logits row at the final prompt
+    position is bitwise the whole-prompt prefill's (and the cache-free
+    full forward's) row."""
+    eng = block_engine
+    for L in (5, 16, 17, 32):
+        p = _prompt(L, seed=40 + L)
+        full = eng.full_logits(p)
+        last = None
+        for off in range(0, L, 8):
+            tokens = np.zeros((2, 8), np.int32)
+            chunk = p[off:off + 8]
+            tokens[1, :len(chunk)] = chunk
+            blk = eng.block(tokens, np.asarray([0, off], np.int32))
+            last = blk[1, L - 1 - off] if off + 8 >= L else None
+        assert last is not None
+        assert np.array_equal(last, full[L - 1]), f"prompt len {L}"
+    assert eng.retraces == 0
+
+
+def test_bf16_kv_cache_within_envelope(engine, lm_trainer):
+    """decode_kv_dtype = bf16 halves the KV bytes; decoding the SAME
+    token sequence through the bf16 cache stays inside the declared
+    SERVE_TOL envelope vs the f32 reference (prefill rows are bitwise —
+    the cast only touches cache reads, which start at the first
+    step)."""
+    from cxxnet_tpu.serve.engine import SERVE_TOL
+    eng16 = DecodeEngine(lm_trainer, slots=2, max_seqlen=32,
+                         kv_dtype="bf16")
+    eng16.warmup()
+    assert eng16.kv_cache_bytes() * 2 == engine.kv_cache_bytes()
+    p = _prompt(9, seed=77)
+    ref = engine.prefill(0, p)
+    got = eng16.prefill(0, p)
+    assert np.array_equal(got, ref)     # prefill reads no cache
+    seq = [int(np.argmax(ref))]
+    worst = 0.0
+    for i in range(8):
+        pos = len(p) + i
+        r = engine.step(np.asarray([seq[-1], 0], np.int32),
+                        np.asarray([pos, 0], np.int32))[0]
+        g = eng16.step(np.asarray([seq[-1], 0], np.int32),
+                       np.asarray([pos, 0], np.int32))[0]
+        denom = float(np.max(np.abs(r))) + 1e-6
+        worst = max(worst, float(np.max(np.abs(g - r))) / denom)
+        seq.append(int(np.argmax(r)))   # both follow the f32 choices
+    assert worst <= SERVE_TOL["bf16"], f"bf16 KV err {worst}"
+    fp = eng16.footprint()
+    if fp:
+        assert fp["kv_saved_bytes"] == eng16.kv_cache_bytes()
+    assert eng16.stats()["kv_dtype"] == "bf16"
+    assert eng16.retraces == 0
+
+
+# ---------------------------------- scheduler units over the fake runner
+
+class FakeDraft:
+    """Fake draft over FakeRunner logits: proposes exactly what the
+    fake flagship verifies (slot + 1), so every proposal is accepted."""
+
+    def __init__(self, fr):
+        self.fr = fr
+        self.slots = fr.slots
+        self.max_seqlen = fr.max_seqlen
+        self.prefills = 0
+        self.steps = 0
+
+    def prefill(self, slot, tokens):
+        self.prefills += 1
+        return self.fr._logits(slot)
+
+    def step(self, tokens, positions):
+        self.steps += 1
+        return np.stack([self.fr._logits(s)
+                         for s in range(self.slots)])
+
+
+def test_scheduler_spec_round_accounting():
+    """Pure thread-protocol spec unit: an always-agreeing fake draft
+    emits spec_k+1 tokens per verify dispatch; draft catch-up ticks run
+    only after full-accept rounds; counters add up."""
+    fr = FakeRunner(slots=2, step_sleep=0.0)
+    fd = FakeDraft(fr)
+    s = StepScheduler(fr, max_new_tokens=9, eos=0, queue_depth=8,
+                      draft=fd, spec_k=3)
+    s.start()
+    try:
+        out = s.submit(np.asarray([1, 2, 3], np.int32), 9)
+    finally:
+        s.close()
+    slot = fr.prefill_log[0][0]
+    assert out == [slot + 1] * 9    # the slot's rigged token throughout
+    # 1 activation token + 2 full rounds of 4 = 9 tokens
+    assert s.n_verify_calls == 2
+    assert s.n_spec_proposed == 6 and s.n_spec_accepted == 6
+    # round 1: 3 proposal steps; round 2: 1 catch-up (post full-accept
+    # lag) + 3 proposals
+    assert s.n_draft_steps == 7 and fd.steps == 7
+    assert fd.prefills == 1
+    st = s.stats()
+    assert st["acceptance_rate"] == 1.0
+    assert st["draft_steps"] == 7 and st["verify_calls"] == 2
+
+
+def test_scheduler_chunked_prefill_interleaves():
+    """Chunk ticks interleave with decode rounds: a long prompt joining
+    a busy scheduler streams in one chunk per loop iteration while the
+    in-flight request keeps emitting tokens — head-of-line blocking is
+    bounded at one chunk, not one whole prefill."""
+    fr = FakeRunner(slots=2, step_sleep=0.004)
+    s = StepScheduler(fr, max_new_tokens=60, eos=0, queue_depth=8,
+                      prefill_chunk=4)
+    s.start()
+    try:
+        ta, a = _submit_async(s, np.arange(1, 4, dtype=np.int32), 60)
+        _wait(lambda: len(fr.step_actives) >= 2)
+        steps_before = len(fr.step_actives)
+        tb, b = _submit_async(s, np.arange(1, 11, dtype=np.int32), 2)
+        tb.join(5.0)
+        assert b["tokens"] is not None and len(b["tokens"]) == 2
+        assert ta.is_alive()            # A never drained for B's prompt
+        ta.join(10.0)
+        assert len(a["tokens"]) == 60
+    finally:
+        s.close()
+    # both prompts chunked: ceil(3/4) + ceil(10/4) = 1 + 3 block ticks
+    assert len(fr.block_log) == 4
+    assert all(w == 4 for w, _ in fr.block_log)
+    # A kept stepping while B's 3 chunks streamed in
+    assert len(fr.step_actives) > steps_before + 1
+    st = s.stats()
+    assert st["prefill_chunks"] == 4 and st["prefills"] == 2
+
+
+def test_scheduler_spec_failure_reaches_all_clients():
+    """A draft failure mid-round latches the scheduler exactly like a
+    flagship failure — every active and queued client gets the error."""
+
+    class DyingDraft(FakeDraft):
+        def step(self, tokens, positions):
+            raise RuntimeError("draft fell over")
+
+    fr = FakeRunner(slots=2, step_sleep=0.0)
+    s = StepScheduler(fr, max_new_tokens=8, eos=0, queue_depth=8,
+                      draft=DyingDraft(fr), spec_k=2)
+    s.start()
+    try:
+        with pytest.raises(RuntimeError, match="draft fell over"):
+            s.submit(np.asarray([1, 2], np.int32), 8)
+        with pytest.raises(RuntimeError, match="draft fell over"):
+            s.submit(np.asarray([1, 2], np.int32), 8)
+    finally:
+        s.close()
+
+
+# --------------------------------------------- CLI task=serve + speculation
+
+@pytest.fixture(scope="module")
+def trained_draft(trained_lm):
+    """A smaller 1-layer draft LM trained over the same token shards —
+    the serve_draft_model snapshot for the speculative CLI run."""
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.models import transformer
+    tmp_path, _, _ = trained_lm
+    net = transformer(vocab=64, seq=32, dim=16, nlayer=1, nhead=2,
+                      packed=True)
+    conf = tmp_path / "draft_train.conf"
+    conf.write_text(f"""
+dev = cpu
+data = train
+iter = text
+  path_tok = {tmp_path}/c_%d.tok
+  tok_count = 2
+iter = packseq
+  seqlen = 32
+iter = end
+{net}
+batch_size = 4
+num_round = 1
+model_dir = {tmp_path}/draft_models
+save_model = 1
+updater = sgd
+eta = 0.05
+silent = 1
+""")
+    assert LearnTask().run([str(conf)]) == 0
+    return str(tmp_path / "draft_models" / "0001.model")
+
+
+def test_cli_serve_gen_speculative_end_to_end(trained_lm, trained_draft):
+    """task=serve with speculation + chunked prefill + bf16 KV cache
+    through the real CLI: retraces stay 0 (every executable AOT-warmed
+    — the ISSUE 19 acceptance criterion), the greedy token stream is
+    identical to a plain non-speculative run, and the serve_gen record
+    carries the acceptance/dispatch telemetry obsv.py renders."""
+    import json
+
+    from cxxnet_tpu.main import LearnTask
+    tmp_path, net, model = trained_lm
+    def conf_text(pred, extra=""):
+        return f"""
+dev = cpu
+task = serve
+model_in = {model}
+pred = {pred}
+iter = text
+  path_tok = {tmp_path}/c_%d.tok
+  tok_count = 2
+iter = packseq
+  seqlen = 32
+iter = end
+{net}
+batch_size = 4
+serve_gen = 1
+decode_slots = 2
+decode_max_seqlen = 32
+serve_gen_tokens = 6
+serve_gen_prompt = 4
+serve_clients = 3
+silent = 1
+{extra}"""
+
+    plain = tmp_path / "spec_plain.conf"
+    plain.write_text(conf_text(f"{tmp_path}/plain_out.txt"))
+    assert LearnTask().run([str(plain)]) == 0
+    spec = tmp_path / "spec_serve.conf"
+    spec.write_text(conf_text(f"{tmp_path}/spec_out.txt", f"""
+serve_draft_model = {trained_draft}
+spec_k = 2
+decode_prefill_chunk = 8
+decode_kv_dtype = f32
+trace_sample = 2
+metrics_sink = jsonl:{tmp_path}/spec_metrics.jsonl
+"""))
+    assert LearnTask().run([str(spec)]) == 0
+    # greedy speculative == plain greedy, end to end through the CLI
+    assert open(tmp_path / "spec_out.txt").read() \
+        == open(tmp_path / "plain_out.txt").read()
+
+    recs = [json.loads(l)
+            for l in open(tmp_path / "spec_metrics.jsonl")]
+    [gen] = [r for r in recs if r["kind"] == "serve_gen"]
+    assert gen["retraces"] == 0          # the acceptance criterion
+    assert gen["spec_k"] == 2
+    assert gen["verify_calls"] > 0 and gen["draft_steps"] > 0
+    assert 0.0 <= gen["acceptance_rate"] <= 1.0
+    assert gen["draft_ms"] >= 0.0 and gen["verify_ms"] >= 0.0
+    assert gen["prefill_chunk"] == 8 and gen["prefill_chunks"] > 0
+    assert gen["footprint"]["draft_bytes"] > 0
+    spans = {r["span"] for r in recs if r["kind"] == "span"}
+    assert {"draft", "verify", "sample", "request"} <= spans
     assert not [t for t in threading.enumerate()
                 if t.name.startswith("cxxnet-decode")
                 or t.name.startswith("cxxnet-serve-gen")]

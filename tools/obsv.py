@@ -175,6 +175,9 @@ def build_report(recs: List[dict], top: int = 10) -> dict:
               "max_seqlen", "gen_tokens", "clients", "sample",
               "retraces", "requests", "tokens", "steps", "prefills",
               "mean_occupancy", "occupancy_hist", "batching",
+              "spec_k", "acceptance_rate", "draft_steps",
+              "verify_calls", "draft_ms", "verify_ms",
+              "prefill_chunk", "prefill_chunks",
               "tok_p50_ms", "tok_p95_ms", "tok_p99_ms", "footprint")
              if k in r}
             for r in by["serve_gen"]]
@@ -474,8 +477,8 @@ def render(rep: dict) -> str:
         out.append(
             f"generation: {len(gen)} run(s); decode retraces past "
             f"warmup: {n_retr}"
-            + ("" if not n_retr else "  <-- a shape escaped the two "
-               "pinned executables"))
+            + ("" if not n_retr else "  <-- a shape escaped the "
+               "pinned executable set"))
         out.append(_table(
             ["model", "batching", "tok/s", "requests", "tokens",
              "steps", "occ", "tok_p99", "kv_cache"],
@@ -485,6 +488,27 @@ def render(rep: dict) -> str:
               _fmt(r.get("mean_occupancy")), _fmt(r.get("tok_p99_ms")),
               _mb((r.get("footprint") or {}).get("kv_cache_bytes"))]
              for r in gen]))
+        spec = [r for r in gen if r.get("spec_k")]
+        if spec:
+            # speculative decoding telemetry (doc/serve.md): accepted
+            # draft tokens per flagship verify dispatch is the whole
+            # speedup story
+            out.append(_table(
+                ["model", "spec_k", "accept", "draft_steps",
+                 "verify_calls", "draft_ms", "verify_ms"],
+                [[str(r.get("model", "?")), _fmt(r.get("spec_k")),
+                  (f"{r['acceptance_rate']:.0%}"
+                   if r.get("acceptance_rate") is not None else "-"),
+                  _fmt(r.get("draft_steps")),
+                  _fmt(r.get("verify_calls")),
+                  _fmt(r.get("draft_ms")), _fmt(r.get("verify_ms"))]
+                 for r in spec]))
+        chunked = [r for r in gen if r.get("prefill_chunk")]
+        if chunked:
+            out.append("chunked prefill: " + "  ".join(
+                f"{r.get('model', '?')}: {_fmt(r.get('prefill_chunks'))}"
+                f" tick(s) of {_fmt(r.get('prefill_chunk'))} col(s)"
+                for r in chunked))
         hist = gen[-1].get("occupancy_hist") or {}
         if hist:
             total = sum(hist.values()) or 1
