@@ -462,7 +462,8 @@ def bench_serve(argv=None) -> dict:
 
             threads = [threading.Thread(target=client,
                                         args=(j, qps / clients),
-                                        daemon=True)
+                                        daemon=True,
+                                        name=f"cxxnet-bench-client-{j}")
                        for j in range(clients)]
             for th in threads:
                 th.start()
@@ -1421,8 +1422,9 @@ def bench_lm_serve(argv=None) -> dict:
                     errs.append(e)
                     return
 
-        threads = [threading.Thread(target=client, daemon=True)
-                   for _ in range(clients)]
+        threads = [threading.Thread(target=client, daemon=True,
+                                    name=f"cxxnet-bench-genclient-{j}")
+                   for j in range(clients)]
         for th in threads:
             th.start()
         for th in threads:

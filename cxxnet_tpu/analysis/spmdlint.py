@@ -103,6 +103,11 @@ FINDING_IDS = {
                            "between",
     "spmd_collectives": "per-axis collective sequence summary",
     "spmd_donation": "donation audit summary / skip notice",
+    "spmd_dist_round_len": "dist_num_worker-sharded iterator feeds a "
+                           "step whose per-round batch count derives "
+                           "from LOCAL iterator length — unequal shards "
+                           "issue divergent collective counts (the "
+                           "multi-host hang class)",
 }
 
 
@@ -432,6 +437,43 @@ def donation_findings(report: Optional[Dict[str, Any]]) -> List[Finding]:
 
 
 # --------------------------------------------------------------- driver
+def dist_round_findings(cfg, ops: Sequence[CollectiveOp]) -> List[Finding]:
+    """Seed rule for the multi-host hang class (ROADMAP item 2).
+
+    When the iterator is sharded ``dist_num_worker`` ways, every rank
+    runs the trainer's round loop — which terminates when the *local*
+    iterator runs dry (``batch = itr.next(); if batch is None: break``
+    in ``main.py``).  The per-round step count, and with it the number
+    of collectives each rank issues, therefore derives from the local
+    shard length: ranks with unequal shard sizes issue divergent
+    collective counts, and the longer ranks hang in their next psum
+    waiting on peers that already left the round.  The iterators'
+    empty-rank assert (``io/text.py`` / ``io/imbin.py`` init) only
+    catches the degenerate zero-shard case, not unequal nonzero ones —
+    hence the WARN whenever sharding meets a collective-bearing step."""
+    try:
+        nworker = int(dict(cfg).get("dist_num_worker", "1"))
+    except (TypeError, ValueError):
+        return []
+    if nworker <= 1 or not ops:
+        return []
+    return [Finding(
+        "warn", "spmd_dist_round_len",
+        f"iterator is sharded dist_num_worker = {nworker} ways but each "
+        "training round ends when the LOCAL iterator is exhausted, so "
+        f"the number of collectives a rank issues per round ({len(ops)} "
+        "per step x local step count) derives from its own shard "
+        "length; ranks with unequal shard sizes issue divergent "
+        "collective counts and the longer ranks hang in the next psum",
+        suggestion="keep per-rank shard counts equal (shard count a "
+                   "multiple of dist_num_worker, equal-length shards); "
+                   "the iterator init asserts only the zero-shard case "
+                   "('a rank with zero data would dispatch no steps and "
+                   "hang the other replicas' collectives'), not unequal "
+                   "nonzero ones",
+        scope="spmd")]
+
+
 def lint_trainer(trainer, closed: ClosedJaxpr, cfg) -> List[Finding]:
     """Run all three SPMD analyses over a built trainer and its traced
     step.  Reads the wire contract from the engine options the config
@@ -448,4 +490,5 @@ def lint_trainer(trainer, closed: ClosedJaxpr, cfg) -> List[Finding]:
     findings.extend(wire_findings(
         ops, wire_bf16=engine.opts.dp_reduce_dtype == "bf16"))
     findings.extend(donation_findings(trainer.step_donation_report()))
+    findings.extend(dist_round_findings(cfg, ops))
     return findings

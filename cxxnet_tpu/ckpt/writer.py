@@ -66,6 +66,7 @@ class AsyncCheckpointWriter:
     def __init__(self, depth: int = 1, on_done=None, tracer=None):
         self._queue: "queue.Queue[Optional[_Job]]" = queue.Queue(
             maxsize=max(int(depth), 1))
+        # racelint: latch(write-once by the writer thread; poll() re-raises on the train thread)
         self._failed: Optional[BaseException] = None
         self._on_done = on_done
         # span tracing (monitor/spans.py): per-shard / manifest /
@@ -75,7 +76,9 @@ class AsyncCheckpointWriter:
             from ..monitor import spans as _spans
             tracer = _spans.NULL
         self._tracer = tracer
-        self._pending = 0
+        # _idle is a Condition over _lock: either spelling acquires the
+        # same mutex, so both satisfy the guard
+        self._pending = 0  # racelint: guarded-by(self._lock, self._idle)
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._thread = threading.Thread(

@@ -158,6 +158,7 @@ class ImageBinIterator(IIterator):
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._pool = None
+        # racelint: atomic(int swap: bumped by the consumer in before_first; the producer re-reads it to detach stale generations)
         self._gen = 0
 
     def set_param(self, name, val):
@@ -270,7 +271,8 @@ class ImageBinIterator(IIterator):
             self._thread.join()
         self._queue = queue.Queue(maxsize=2)
         self._thread = threading.Thread(
-            target=self._producer, args=(self._gen, self._queue), daemon=True)
+            target=self._producer, args=(self._gen, self._queue),
+            daemon=True, name="cxxnet-imbin-producer")
         self._thread.start()
         self._page = []
         self._page_pos = 0
@@ -324,7 +326,7 @@ class ImageBinIterator(IIterator):
                 from concurrent.futures import ThreadPoolExecutor
                 self._pool = ThreadPoolExecutor(
                     max_workers=self.decode_thread_num,
-                    thread_name_prefix="imbin-decode")
+                    thread_name_prefix="cxxnet-imbin-decode")
             window = 2 * self.decode_thread_num
             while (self._submit_pos < len(self._page)
                    and self._submit_pos - self._page_pos < window):

@@ -457,7 +457,8 @@ class ThreadBufferIterator(IIterator):
         q = queue.Queue(maxsize=self.max_buffer)
         self._queue = q
         self._thread = threading.Thread(
-            target=self._producer, args=(self._gen, q), daemon=True)
+            target=self._producer, args=(self._gen, q),
+            daemon=True, name="cxxnet-io-buffer-producer")
         self._thread.start()
 
     def next(self):

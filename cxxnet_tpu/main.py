@@ -198,6 +198,7 @@ class LearnTask:
         self.itr_train = None
         self.itr_evals = []
         self.eval_names = []
+        # racelint: atomic(whole-object swap published by init_data before the serve producer thread starts; the producer only reads)
         self.itr_pred = None
 
     def set_param(self, name: str, val: str) -> None:

@@ -43,54 +43,67 @@ class Histogram:
     after, and never more than a few KB of host memory per series.  The
     serving-telemetry consumer (``pred``/``extract`` per-batch latency,
     the ``latency`` JSONL record — ROADMAP item 1) reads tail latency
-    through this."""
+    through this.
+
+    Thread-safe: ``serve_latency_sec`` is observed from every serve
+    client thread at once, so the count/total/reservoir update is one
+    critical section — the unlocked read-modify-write it replaced lost
+    observations under contention (two clients reading the same
+    ``count`` and both writing ``count + 1``)."""
 
     _RESERVOIR = 2048
 
     __slots__ = ("count", "total", "min", "max", "last", "_samples",
-                 "_rng")
+                 "_rng", "_lock")
 
     def __init__(self):
-        self.count = 0
-        self.total = 0.0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
-        self.last: Optional[float] = None
-        self._samples: List[float] = []
+        self.count = 0                        # racelint: guarded-by(self._lock)
+        self.total = 0.0                      # racelint: guarded-by(self._lock)
+        self.min: Optional[float] = None      # racelint: guarded-by(self._lock)
+        self.max: Optional[float] = None      # racelint: guarded-by(self._lock)
+        self.last: Optional[float] = None     # racelint: guarded-by(self._lock)
+        self._samples: List[float] = []       # racelint: guarded-by(self._lock)
         # fixed seed: summaries must not vary run to run on equal input
         self._rng = random.Random(0x5EED)
+        self._lock = threading.Lock()
 
+    # racelint: thread(shared)
     def observe(self, value: float) -> None:
         v = float(value)
-        self.count += 1
-        self.total += v
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
-        self.last = v
-        if len(self._samples) < self._RESERVOIR:
-            self._samples.append(v)
-        else:  # reservoir replacement: keep a uniform sample
-            j = self._rng.randrange(self.count)
-            if j < self._RESERVOIR:
-                self._samples[j] = v
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+            if len(self._samples) < self._RESERVOIR:
+                self._samples.append(v)
+            else:  # reservoir replacement: keep a uniform sample
+                j = self._rng.randrange(self.count)
+                if j < self._RESERVOIR:
+                    self._samples[j] = v
 
     _nearest_rank = staticmethod(nearest_rank)
 
     def percentile(self, q: float) -> Optional[float]:
         """q in [0, 100]; nearest-rank over the reservoir."""
-        if not self._samples:
+        with self._lock:
+            s = sorted(self._samples)
+        if not s:
             return None
-        return self._nearest_rank(sorted(self._samples), q)
+        return self._nearest_rank(s, q)
 
     def summary(self) -> Dict[str, float]:
-        out = {"count": self.count, "sum": self.total}
-        if self.count:
-            s = sorted(self._samples)  # one sort feeds all three ranks
-            out.update(min=self.min, max=self.max,
-                       mean=self.total / self.count, last=self.last,
-                       p50=self._nearest_rank(s, 50),
-                       p95=self._nearest_rank(s, 95),
-                       p99=self._nearest_rank(s, 99))
+        with self._lock:
+            out = {"count": self.count, "sum": self.total}
+            if self.count:
+                # one sort feeds all three ranks
+                s = sorted(self._samples)
+                out.update(min=self.min, max=self.max,
+                           mean=self.total / self.count, last=self.last,
+                           p50=self._nearest_rank(s, 50),
+                           p95=self._nearest_rank(s, 95),
+                           p99=self._nearest_rank(s, 99))
         return out
 
 
@@ -110,7 +123,8 @@ class JsonlSink:
             pass  # missing or empty file: nothing to repair
         # append-only stream by design (torn tails are tolerated by
         # every JSONL reader here; atomic_write would buffer the run)
-        self._fo: TextIO = open(path, "a")  # disclint: ok(atomic-write)
+        # disclint: ok(atomic-write)
+        self._fo: TextIO = open(path, "a")  # racelint: guarded-by(self._lock)
         if torn:
             self._fo.write("\n")
         # the async checkpoint writer emits its `ckpt` record from the
@@ -119,6 +133,7 @@ class JsonlSink:
         # or two records can interleave mid-line (torn JSONL)
         self._lock = threading.Lock()
 
+    # racelint: thread(shared)
     def write(self, record: Dict[str, Any]) -> None:
         line = json.dumps(record, sort_keys=True, default=_jsonable) + "\n"
         with self._lock:
@@ -158,9 +173,13 @@ class MetricsRegistry:
     already shares)."""
 
     def __init__(self):
+        # racelint: atomic(per-key writes, single writer per key by convention; the scrape path reads via copy_racy)
         self.counters: Dict[str, int] = {}
+        # racelint: atomic(per-key float store; scrape reads via copy_racy)
         self.gauges: Dict[str, float] = {}
+        # racelint: atomic(per-key insert via setdefault; Histogram itself is internally locked)
         self.histograms: Dict[str, Histogram] = {}
+        # racelint: atomic(whole-object swap; emit() snapshots one reference per call)
         self.sink: Optional[JsonlSink] = None
         # registry birth stamp: the admin plane's /statusz uptime and
         # the promtext scrape both date from here (serve/admin.py)
@@ -170,8 +189,9 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------- config
     def configure_sink(self, spec: str) -> None:
-        if self.sink is not None:
-            self.sink.close()
+        old, self.sink = self.sink, None
+        if old is not None:
+            old.close()
         self.sink = create_sink(spec)
 
     def configure_tracer(self, sample: int) -> None:
@@ -185,17 +205,24 @@ class MetricsRegistry:
         return self.sink is not None
 
     # ----------------------------------------------------------- instruments
+    # racelint: thread(shared)
     def counter_inc(self, name: str, n: int = 1) -> int:
         self.counters[name] = self.counters.get(name, 0) + n
         return self.counters[name]
 
+    # racelint: thread(shared)
     def set_gauge(self, name: str, value: float) -> None:
         self.gauges[name] = float(value)
 
+    # racelint: thread(shared)
     def observe(self, name: str, value: float) -> None:
         h = self.histograms.get(name)
         if h is None:
-            h = self.histograms[name] = Histogram()
+            # setdefault is one C-level dict op: two threads first-
+            # observing the same series converge on ONE Histogram —
+            # the get-then-insert it replaced let the loser's instance
+            # (and its observation) vanish
+            h = self.histograms.setdefault(name, Histogram())
         h.observe(value)
 
     def snapshot(self) -> Dict[str, Any]:
@@ -205,31 +232,37 @@ class MetricsRegistry:
                                for k, h in self.histograms.items()}}
 
     # --------------------------------------------------------------- records
+    # racelint: thread(shared)
     def emit(self, kind: str, **fields) -> None:
         """Write one JSONL record (no-op without a sink).  Sink I/O
         failures (disk full, path gone) disable the sink and warn instead
         of propagating — telemetry must never kill a training run."""
-        if self.sink is None:
+        # one snapshot of the reference: a concurrent emit failure (or
+        # close()) swaps self.sink to None, and re-reading it after the
+        # None-check raised AttributeError into the train loop
+        sink = self.sink
+        if sink is None:
             return
         rec = {"ts": round(time.time(), 3), "kind": kind}
         rec.update(fields)
         try:
-            self.sink.write(rec)
+            sink.write(rec)
         except (OSError, ValueError) as e:  # ValueError: closed file
-            path = self.sink.path
+            path = sink.path
             try:
-                self.sink.close()
+                sink.close()
             except (OSError, ValueError):
                 pass
-            self.sink = None
+            if self.sink is sink:
+                self.sink = None
             from . import log
             log.warn(f"metrics sink {path}: {e}; telemetry disabled "
                      "for the rest of the run")
 
     def close(self) -> None:
-        if self.sink is not None:
-            self.sink.close()
-            self.sink = None
+        sink, self.sink = self.sink, None
+        if sink is not None:
+            sink.close()
 
 
 def device_memory_gauges(devices) -> Dict[str, float]:

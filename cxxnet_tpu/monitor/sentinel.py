@@ -36,6 +36,7 @@ flushes per record; see metrics.JsonlSink).
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -111,7 +112,13 @@ class SentinelBank:
             log.warn(f"sentinel_rel={rel} must be > 0; using 0.2")
             rel = 0.2
         self.metrics = metrics
-        self.ring: deque = deque(maxlen=max(int(ring), 1))
+        # serving runs touch the ring from two threads at once: the
+        # reporter appends serve_window records while the main thread's
+        # abort path runs flight_dump BEFORE the reporter is joined —
+        # list(ring)-during-append raises "deque mutated during
+        # iteration" and costs the flight evidence at the worst moment
+        self.ring: deque = deque(maxlen=max(int(ring), 1))  # racelint: guarded-by(self._lock)
+        self._lock = threading.Lock()
         self.sentinels = {
             "examples_per_sec": Sentinel("examples_per_sec", "drop",
                                          rel, warmup, alpha),
@@ -130,7 +137,7 @@ class SentinelBank:
             "serve_queue_depth": Sentinel("serve_queue_depth", "rise",
                                           rel, warmup, alpha),
         }
-        self.anomalies: List[Dict] = []
+        self.anomalies: List[Dict] = []  # racelint: guarded-by(self._lock)
         # optional anomaly callback (serve/admin.FlightCapture.trigger
         # rides here): called AFTER the anomaly/flight records land, so
         # a failing hook can never cost the primary evidence
@@ -143,9 +150,11 @@ class SentinelBank:
         Without this a resumed run re-warms its baselines from scratch
         and the first post-resume rounds can neither fire nor extend a
         pre-kill trend."""
+        with self._lock:
+            ring = list(self.ring)
         return {"sentinels": {k: {"mean": s.ewma.mean, "seen": s.seen}
                               for k, s in self.sentinels.items()},
-                "ring": list(self.ring)}
+                "ring": ring}
 
     def set_state(self, st: Dict) -> None:
         for k, sv in (st.get("sentinels") or {}).items():
@@ -155,12 +164,14 @@ class SentinelBank:
             mean = sv.get("mean")
             s.ewma.mean = None if mean is None else float(mean)
             s.seen = int(sv.get("seen", 0))
-        for rec in st.get("ring") or []:
-            self.ring.append(rec)
+        with self._lock:
+            for rec in st.get("ring") or []:
+                self.ring.append(rec)
 
     # ------------------------------------------------------------ hooks
     def observe_step(self, rec: Dict) -> None:
-        self.ring.append(dict(rec, kind="step"))
+        with self._lock:
+            self.ring.append(dict(rec, kind="step"))
         if rec.get("examples_per_sec"):
             self._check("examples_per_sec", rec["examples_per_sec"], rec)
 
@@ -172,6 +183,7 @@ class SentinelBank:
         if rec.get("comm_share"):
             self._check("comm_share", rec["comm_share"], rec)
 
+    # racelint: thread(reporter)
     def observe_serve(self, rec: Dict) -> None:
         """One ``serve_window`` record: windowed p99 latency (rise),
         achieved QPS (drop), and live queue depth (rise).  Windows
@@ -179,7 +191,8 @@ class SentinelBank:
         windows leading into it.  A zero queue-depth baseline never
         fires (the Sentinel contract) — depth watching arms only once
         the server actually runs a standing queue."""
-        self.ring.append(dict(rec, kind="serve_window"))
+        with self._lock:
+            self.ring.append(dict(rec, kind="serve_window"))
         if rec.get("p99_ms"):
             self._check("serve_p99_ms", rec["p99_ms"], rec)
         if rec.get("qps"):
@@ -194,7 +207,8 @@ class SentinelBank:
         for k in ("round", "step", "global_step", "window"):
             if k in rec:
                 hit[k] = rec[k]
-        self.anomalies.append(hit)
+        with self._lock:
+            self.anomalies.append(hit)
         self.metrics.counter_inc("anomalies")
         self.metrics.emit("anomaly", **hit)
         self.flight_dump(f"anomaly: {name} {hit['direction']} "
@@ -211,10 +225,15 @@ class SentinelBank:
     def flight_dump(self, reason: str) -> None:
         """Dump (and clear) the step ring as one ``flight`` record.  An
         empty ring writes nothing — a TrainingDiverged on the very first
-        monitored step has no history to preserve."""
-        if not self.ring:
+        monitored step has no history to preserve.  Snapshot-and-clear
+        happens under the ring lock (the reporter may still be
+        appending); the sink write runs outside it so slow disk never
+        blocks the reporter's next window."""
+        with self._lock:
+            records = list(self.ring)
+            self.ring.clear()
+        if not records:
             return
         self.metrics.emit("flight", reason=reason,
-                          n_records=len(self.ring),
-                          records=list(self.ring))
-        self.ring.clear()
+                          n_records=len(records),
+                          records=records)

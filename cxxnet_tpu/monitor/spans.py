@@ -140,11 +140,14 @@ class SpanTracer:
 
     def __init__(self, metrics, sample: int = 0):
         self.metrics = metrics
+        # racelint: atomic(int swap: the flight capture's reporter thread re-arms it; every reader re-reads per call)
         self.sample = int(sample)
         self._epoch = time.perf_counter()
         self._lock = threading.Lock()
-        self._next_id = 0     # last allocated trace_id
-        self._n_seen = 0      # requests offered to the sampler
+        # last allocated trace_id
+        self._next_id = 0  # racelint: guarded-by(self._lock)
+        # requests offered to the sampler
+        self._n_seen = 0   # racelint: guarded-by(self._lock)
         self._tls = threading.local()
 
     # ------------------------------------------------------------- state
@@ -153,10 +156,12 @@ class SpanTracer:
         """True only when sampling is armed AND records can land."""
         return self.sample > 0 and self.metrics.sink is not None
 
+    # racelint: thread(reporter)
     def configure(self, sample: int) -> None:
         """(Re)arm: ``trace_sample = N`` traces every Nth request,
         ``0`` disables.  The tracer object is stable so components that
-        grabbed ``metrics.tracer`` early see the change."""
+        grabbed ``metrics.tracer`` early see the change.  Called from
+        the reporter thread when a flight capture boosts sampling."""
         self.sample = int(sample)
 
     @property
@@ -166,6 +171,7 @@ class SpanTracer:
         flight capture (serve/admin.py) names the spans it boosted —
         ``serve_flight`` records carry ``trace_first``/``trace_last``
         from exactly this."""
+        # racelint: ok(race_unguarded) — GIL-atomic int read; the flight heuristic tolerates a watermark one id stale
         return self._next_id
 
     @staticmethod

@@ -80,6 +80,7 @@ class MicroBatcher:
         self.name = name
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_depth)))
         self._thread: Optional[threading.Thread] = None
+        # racelint: latch(write-once by the dispatcher; racy reads fan the failure out to submitters)
         self._failed: Optional[BaseException] = None
         self._closing = False
         # dispatch accounting for the ``serve`` record / bench report.
@@ -87,13 +88,14 @@ class MicroBatcher:
         # the dispatcher (drain) — under _stats_lock: sampling only at
         # dispatch time made bursts that arrived and fully drained
         # between two dispatches invisible to depth_max
-        self.n_requests = 0
-        self.n_batches = 0
-        self.rows_served = 0
+        self.n_requests = 0    # racelint: atomic(plain-int bump, dispatcher is the only writer; scrape reads tolerate staleness)
+        self.n_batches = 0     # racelint: atomic(plain-int bump, dispatcher-only writer)
+        self.rows_served = 0   # racelint: atomic(plain-int bump, dispatcher-only writer)
+        # racelint: atomic(per-key int bump, dispatcher-only writer; the scrape path copies via copy_racy)
         self.batch_hist: Dict[int, int] = {}
-        self.depth_sum = 0
-        self.depth_samples = 0
-        self.depth_max = 0
+        self.depth_sum = 0      # racelint: guarded-by(self._stats_lock)
+        self.depth_samples = 0  # racelint: guarded-by(self._stats_lock)
+        self.depth_max = 0      # racelint: guarded-by(self._stats_lock)
         self._stats_lock = threading.Lock()
         # windowed stats for the serve-side sentinels (opt-in: the
         # reporter thread in task_serve flips track_window on and
@@ -351,11 +353,19 @@ class MicroBatcher:
 
     @property
     def mean_depth(self) -> float:
-        return self.depth_sum / self.depth_samples \
-            if self.depth_samples else 0.0
+        # sum and count move together only under the lock: an unlocked
+        # pair read can tear across a concurrent _observe_depth and
+        # report a mean no sample window ever had
+        with self._stats_lock:
+            return self.depth_sum / self.depth_samples \
+                if self.depth_samples else 0.0
 
     def stats(self) -> Dict[str, Any]:
         """Dispatch accounting for the ``serve`` JSONL record."""
+        with self._stats_lock:
+            depth_mean = self.depth_sum / self.depth_samples \
+                if self.depth_samples else 0.0
+            depth_max = self.depth_max
         return {
             "requests": self.n_requests,
             "batches": self.n_batches,
@@ -363,8 +373,8 @@ class MicroBatcher:
             "mean_batch": round(self.mean_batch, 2),
             "batch_hist": {str(k): v
                            for k, v in sorted(self.batch_hist.items())},
-            "queue_depth_mean": round(self.mean_depth, 2),
-            "queue_depth_max": self.depth_max,
+            "queue_depth_mean": round(depth_mean, 2),
+            "queue_depth_max": depth_max,
         }
 
 
@@ -456,6 +466,7 @@ class StepScheduler:
         self.name = name
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_depth)))
         self._thread: Optional[threading.Thread] = None
+        # racelint: latch(write-once by the decode loop; racy reads fan the failure out to submitters)
         self._failed: Optional[BaseException] = None
         self._closing = False
         self._draining = False
@@ -464,21 +475,25 @@ class StepScheduler:
         self._filling: Dict[int, _GenRequest] = {}
         self._fill_order: list = []
         self._free: list = list(range(runner.slots))
-        self._req_seq = 0
-        # accounting for the serve_gen record / --lm-serve sweep
-        self.n_requests = 0
-        self.n_tokens = 0
-        self.n_steps = 0
-        self.n_prefills = 0
-        self.n_prefill_chunks = 0
-        self.n_draft_steps = 0
-        self.n_verify_calls = 0
-        self.n_spec_proposed = 0
-        self.n_spec_accepted = 0
-        self._draft_wall = 0.0
-        self._verify_wall = 0.0
+        self._req_seq = 0  # racelint: guarded-by(self._stats_lock)
+        # accounting for the serve_gen record / --lm-serve sweep.
+        # Counters below are decode-loop-single-writer plain-int bumps;
+        # the admin scrape path reads them unlocked by design (PR 17)
+        self.n_requests = 0        # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_tokens = 0          # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_steps = 0           # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_prefills = 0        # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_prefill_chunks = 0  # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_draft_steps = 0     # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_verify_calls = 0    # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_spec_proposed = 0   # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.n_spec_accepted = 0   # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self._draft_wall = 0.0     # racelint: atomic(float bump, decode-loop-only writer)
+        self._verify_wall = 0.0    # racelint: atomic(float bump, decode-loop-only writer)
+        # racelint: atomic(per-key int bump, decode-loop-only writer; scrape copies via copy_racy)
         self.occ_hist: Dict[int, int] = {}
-        self._tok_lats: list = []       # per-step decode+sample wall
+        # per-step decode+sample wall
+        self._tok_lats: list = []  # racelint: guarded-by(self._stats_lock)
         self._stats_lock = threading.Lock()
 
     # ------------------------------------------------------------- client

@@ -239,13 +239,14 @@ class DecodeEngine:
         # draft engine warms against the flagship's metrics), so
         # ``retraces`` must not charge one engine for another's warmup
         self._trace_count = 0
+        # racelint: atomic(float swap, written once during warmup before handlers can scrape)
         self.warmup_sec = 0.0
         # executable-call accounting for /statusz (serve/admin.py):
         # dispatcher-thread writes, GIL-atomic reads, no lock
-        self.prefill_calls = 0
-        self.step_calls = 0
-        self.block_calls = 0
-        self.prompt_tokens = 0
+        self.prefill_calls = 0   # racelint: atomic(plain-int bump, decode-loop-only writer; scrape reads are GIL-atomic)
+        self.step_calls = 0      # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.block_calls = 0     # racelint: atomic(plain-int bump, decode-loop-only writer)
+        self.prompt_tokens = 0   # racelint: atomic(plain-int bump, decode-loop-only writer)
 
     # ------------------------------------------------------------- build
     def _alloc_caches(self):
@@ -428,10 +429,12 @@ class DecodeEngine:
             fp["kv_saved_bytes"] = kv
         return fp
 
+    # racelint: thread(handler)
     def stats(self) -> Dict[str, object]:
         """Executable-call accounting for /statusz: prefill/step/block
         call counts, prompt-token volume, and the fixed cache
-        geometry."""
+        geometry.  Runs on admin handler threads (scrape-path rule:
+        unlocked GIL-atomic reads, never a dispatcher lock)."""
         return {"prefill_calls": self.prefill_calls,
                 "step_calls": self.step_calls,
                 "block_calls": self.block_calls,

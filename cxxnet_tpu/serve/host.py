@@ -198,11 +198,13 @@ class ModelHost:
 
     def __init__(self):
         self._models: Dict[str, ServeModel] = {}
+        # racelint: atomic(bool swap: mark_ready()/close() write on the driving thread; /healthz handlers only read)
         self._ready = False
         self.admin = None       # AdminServer once start_admin() ran
 
     # ----------------------------------------------------- ready lifecycle
     @property
+    # racelint: thread(handler)
     def ready(self) -> bool:
         return self._ready
 

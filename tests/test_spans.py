@@ -430,8 +430,22 @@ def test_spans2trace_export(tmp_path):
     slices = [e for e in evs if e["ph"] == "X"]
     assert all(e["dur"] >= 1 and e["ts"] >= 0 for e in slices)
     # thread metadata: one track per host thread seen in the spans
-    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    metas = [e for e in evs if e["ph"] == "M"]
+    names = {e["args"]["name"] for e in metas
+             if e["name"] == "thread_name"}
     assert any(n.startswith("cxxnet-serve-batcher") for n in names)
+    # every named track also carries a sort index, and the dispatcher
+    # plane sorts above client threads (admin/scheduler roles are
+    # covered by the THREAD_SORT_RANKS table)
+    ranked = {e["tid"]: e["args"]["sort_index"] for e in metas
+              if e["name"] == "thread_sort_index"}
+    tids = {e["tid"] for e in metas if e["name"] == "thread_name"}
+    assert set(ranked) == tids
+    assert spans2trace.sort_rank("cxxnet-serve-batcher-0") \
+        < spans2trace.sort_rank("cxxnet-serve-client-3")
+    assert spans2trace.sort_rank("cxxnet-serve-admin") \
+        < spans2trace.sort_rank("cxxnet-serve-sentinel")
+    assert spans2trace.sort_rank("MainThread") == 90
     # flow events pair up s->f per rider of each dispatch
     starts = [e for e in evs if e["ph"] == "s"]
     finishes = [e for e in evs if e["ph"] == "f"]

@@ -187,6 +187,24 @@ def test_wire_findings_only_fire_on_declared_bf16():
     assert hits[0].severity == "error"
 
 
+def test_dist_round_findings_warn_on_sharded_iterator():
+    op = spmdlint.CollectiveOp("psum", ("data",), "float32", (4,), 16)
+    cfg = [("dist_num_worker", "4"), ("eta", "0.1")]
+    hits = spmdlint.dist_round_findings(cfg, [op])
+    assert [f.key for f in hits] == ["spmd_dist_round_len"]
+    assert hits[0].severity == "warn"
+    assert "LOCAL iterator" in hits[0].message
+    # did-you-mean points at the empty-rank assert contract
+    assert "zero data" in hits[0].suggestion
+    # quiet cases: unsharded, collective-free step, unparsable value
+    assert not spmdlint.dist_round_findings([("dist_num_worker", "1")],
+                                            [op])
+    assert not spmdlint.dist_round_findings(cfg, [])
+    assert not spmdlint.dist_round_findings([("dist_num_worker", "x")],
+                                            [op])
+    assert not spmdlint.dist_round_findings([("eta", "0.1")], [op])
+
+
 def test_donation_findings_classes():
     rows = [
         {"tree": "params", "path": "['fc']['wmat']", "bytes": 1 << 20,

@@ -1,0 +1,359 @@
+"""monitor/threadcheck.py: the lock-witness sanitizer + interleaving
+harness (dynamic half of racelint — doc/lint.md).
+
+Three layers:
+
+* **witness units**: ``checked()`` subclasses of the real telemetry
+  classes (Histogram, SentinelBank, FlightCapture, JsonlSink) raise
+  :class:`LockWitnessError` on an unlocked touch of a guarded-by
+  attribute and stay silent on the disciplined paths.
+* **negative fixture**: a pre-fix copy of the unlocked
+  ``Histogram.observe`` read-modify-write, driven by
+  :func:`run_interleaved` to the exact schedule that loses an update —
+  the bug class is *demonstrated*, not assumed.
+* **post-fix stress**: the shipped classes under :func:`stress`
+  (barrier + aggressive switch interval) keep exact counts and emit
+  untorn JSONL — the regression tests for the races racelint surfaced.
+"""
+
+import json
+import threading
+
+import pytest
+
+from cxxnet_tpu.monitor import threadcheck
+from cxxnet_tpu.monitor.metrics import (Histogram, JsonlSink,
+                                        MetricsRegistry)
+from cxxnet_tpu.monitor.sentinel import SentinelBank
+from cxxnet_tpu.serve.admin import FlightCapture, copy_racy
+
+
+# ------------------------------------------------------------ lock witness
+
+def test_witness_lock_ownership():
+    lk = threadcheck.WitnessLock()
+    assert not lk.held_by_me() and not lk.locked()
+    with lk:
+        assert lk.held_by_me() and lk.locked()
+        # ownership is per-thread, not per-process
+        seen = []
+        t = threading.Thread(target=lambda: seen.append(lk.held_by_me()),
+                             name="cxxnet-test-owner")
+        t.start()
+        t.join()
+        assert seen == [False]
+    assert not lk.held_by_me()
+    assert lk.acquisitions == 1
+
+
+def test_witness_lock_delegates_to_inner():
+    """A Condition built over the same inner lock still excludes the
+    witness wrapper (mutual exclusion lives in the wrapped lock)."""
+    inner = threading.Lock()
+    lk = threadcheck.WitnessLock(inner)
+    with lk:
+        assert inner.locked()
+        assert not lk.acquire(blocking=False)
+    assert not inner.locked()
+
+
+def test_held_understands_rlock_and_condition():
+    rl = threading.RLock()
+    assert not threadcheck._held(rl)
+    with rl:
+        assert threadcheck._held(rl)
+    cv = threading.Condition()
+    assert not threadcheck._held(cv)
+    with cv:
+        assert threadcheck._held(cv)
+
+
+class ToyBox:
+    """Witness fixture: one guarded attribute, annotated exactly like
+    production code so collect_policies() reads the map from THIS file."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # racelint: guarded-by(self._lock)
+
+    def put(self, x):
+        with self._lock:
+            self.items.append(x)
+
+
+def test_checked_toy_class():
+    Checked = threadcheck.checked(ToyBox)
+    assert Checked._threadcheck_guarded == {"items": ("_lock",)}
+    box = Checked()
+    box.items.append(0)        # un-armed: no witness
+    threadcheck.arm(box)
+    assert isinstance(box._lock, threadcheck.WitnessLock)
+    box.put(1)                 # disciplined path passes
+    with box._lock:
+        assert box.items == [0, 1]
+    with pytest.raises(threadcheck.LockWitnessError) as ei:
+        box.items
+    assert "items" in str(ei.value) and "_lock" in str(ei.value)
+    with pytest.raises(threadcheck.LockWitnessError):
+        box.items = []
+    threadcheck.disarm(box)
+    assert box.items == [0, 1]  # disarmed: free access again
+
+
+def test_arm_rejects_unchecked_instances():
+    with pytest.raises(TypeError):
+        threadcheck.arm(ToyBox())
+
+
+def test_checked_histogram_slots_class():
+    """Histogram carries __slots__; the witness subclass delegates
+    storage to the slot members and still catches unlocked touches."""
+    h = threadcheck.checked(Histogram)()
+    threadcheck.arm(h)
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)           # internally locked: passes armed
+    assert h.summary()["count"] == 3
+    assert h.percentile(50) == 2.0
+    with pytest.raises(threadcheck.LockWitnessError):
+        h.count                # the pre-fix scrape idiom now fails loudly
+    with h._lock:
+        assert h.count == 3
+
+
+def test_checked_sentinel_bank_ring():
+    bank = threadcheck.checked(SentinelBank)(MetricsRegistry())
+    threadcheck.arm(bank)
+    bank.observe_step({"examples_per_sec": 10.0})
+    assert bank.state()["ring"]          # locked copy passes
+    with pytest.raises(threadcheck.LockWitnessError):
+        list(bank.ring)                  # the flight_dump bug, witnessed
+
+
+def test_checked_flight_capture():
+    fc = threadcheck.checked(FlightCapture)(MetricsRegistry(), lambda: 0)
+    threadcheck.arm(fc)
+    assert fc.trigger("test-anomaly") is True
+    assert fc.trigger("second") is False    # idempotent while armed
+    assert fc.tick() is None                # window 1 of max_ticks
+    with pytest.raises(threadcheck.LockWitnessError):
+        fc.armed
+
+
+def test_checked_jsonl_sink(tmp_path):
+    sink = threadcheck.checked(JsonlSink)(str(tmp_path / "m.jsonl"))
+    threadcheck.arm(sink)
+    sink.write({"kind": "step", "n": 1})
+    with pytest.raises(threadcheck.LockWitnessError):
+        sink._fo
+    sink.close()
+
+
+# ------------------------------------------------------------ interleaving
+
+def test_hook_is_noop_without_callback():
+    threadcheck.clear_hooks()
+    threadcheck.hook("nobody-listens")    # must not raise
+    fired = []
+    threadcheck.set_hook("x", lambda: fired.append(1))
+    threadcheck.hook("x")
+    threadcheck.clear_hooks()
+    threadcheck.hook("x")
+    assert fired == [1]
+
+
+class RacyCounter:
+    """Negative fixture: the PRE-FIX ``Histogram.observe`` shape — an
+    unlocked read-modify-write (racelint: race_undeclared) with the
+    harness hook between the read and the write.  Kept so the harness
+    demonstrably reproduces the bug class the fix removed."""
+
+    def __init__(self):
+        self.count = 0
+
+    def observe(self):
+        c = self.count
+        threadcheck.hook("racy-counter-mid")
+        self.count = c + 1
+
+
+def test_interleaving_reproduces_the_prefix_lost_update():
+    r = RacyCounter()
+    threadcheck.run_interleaved(r.observe, r.observe, "racy-counter-mid")
+    # two observes, ONE survives: thread A read 0, parked; B read 0 and
+    # wrote 1; A resumed and wrote its stale 0 + 1 over B's update
+    assert r.count == 1
+
+
+def test_stress_histogram_keeps_exact_count():
+    """Post-fix side: the shipped (locked) Histogram under the same
+    contention the fixture loses updates to."""
+    h = Histogram()
+    threadcheck.stress(lambda i: h.observe(float(i)), threads=4,
+                       iters=250)
+    s = h.summary()
+    assert s["count"] == 1000
+    assert s["sum"] == 250 * (0.0 + 1.0 + 2.0 + 3.0)
+
+
+@pytest.mark.slow
+def test_stress_histogram_heavy():
+    h = Histogram()
+    threadcheck.stress(lambda i: h.observe(1.0), threads=8, iters=2000)
+    assert h.summary()["count"] == 16000
+
+
+def test_stress_registry_observe_single_series():
+    """Two threads first-observing one series must converge on ONE
+    Histogram (the get-then-insert it replaced dropped the loser's
+    instance and its observation)."""
+    reg = MetricsRegistry()
+    threadcheck.stress(lambda i: reg.observe("lat", 1.0), threads=4,
+                       iters=100)
+    assert len(reg.histograms) == 1
+    assert reg.histograms["lat"].summary()["count"] == 400
+
+
+# ------------------------------------------------- copy_racy (scrape path)
+
+class _FlakyMap:
+    """Mapping whose keys() raises like a dict mutated mid-iteration for
+    the first ``fail`` calls — the deterministic stand-in for a writer
+    thread growing the dict under the scrape."""
+
+    def __init__(self, data, fail):
+        self.data = dict(data)
+        self.fail = fail
+        self.calls = 0
+
+    def keys(self):
+        self.calls += 1
+        if self.calls <= self.fail:
+            raise RuntimeError("dictionary changed size during iteration")
+        return list(self.data.keys())
+
+    def __getitem__(self, k):
+        if k == "gone":
+            raise KeyError(k)    # deleted between keys() and the read
+        return self.data[k]
+
+
+def test_copy_racy_bounded_retry_converges():
+    m = _FlakyMap({"a": 1, "b": 2}, fail=3)
+    assert copy_racy(m) == {"a": 1, "b": 2}
+    assert m.calls == 4          # 3 failed tries + the one that landed
+
+
+def test_copy_racy_fallback_tolerates_vanishing_keys():
+    m = _FlakyMap({"a": 1, "gone": 2}, fail=8)   # every dict() try fails
+    assert copy_racy(m) == {"a": 1}              # item-at-a-time fallback
+
+
+def test_copy_racy_under_live_writer():
+    """Satellite contract: bounded retry under a REAL mutating writer —
+    the admin scrape must neither raise nor lock the dispatcher."""
+    d = {}
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            i = 0
+            while not stop.is_set():
+                d[f"k{i}"] = i
+                i += 1
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    t = threading.Thread(target=writer, name="cxxnet-test-writer",
+                         daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = copy_racy(d)
+            assert isinstance(snap, dict)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+    # a snapshot is a prefix of the writer's inserts: every value matches
+    assert all(snap[k] == int(k[1:]) for k in snap)
+
+
+# --------------------------------------------------- JSONL sink under fire
+
+def test_jsonl_sink_concurrent_writers_no_torn_lines(tmp_path):
+    """The checkpoint-writer thread and the train thread emit through
+    one sink: every line in the file must parse (satellite contract —
+    the sink lock is what keeps records from interleaving mid-line)."""
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry()
+    reg.configure_sink(f"jsonl:{path}")
+    threadcheck.stress(
+        lambda i: reg.emit("ckpt" if i % 2 else "step", worker=i,
+                           payload="x" * 256),
+        threads=4, iters=100)
+    reg.close()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 400
+    kinds = {json.loads(l)["kind"] for l in lines}   # every line parses
+    assert kinds == {"ckpt", "step"}
+
+
+def test_emit_concurrent_with_sink_swap(tmp_path):
+    """Regression for the sink TOCTOU: emit() snapshots the reference
+    once, so a concurrent configure_sink()/close() can no longer turn
+    the None-check into an AttributeError inside the train loop."""
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def emitter():
+        try:
+            while not stop.is_set():
+                reg.emit("step", n=1)
+        except BaseException as e:
+            errors.append(e)
+
+    t = threading.Thread(target=emitter, name="cxxnet-test-emitter",
+                         daemon=True)
+    t.start()
+    try:
+        for _ in range(50):
+            reg.configure_sink(f"jsonl:{path}")
+            reg.configure_sink("none")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+    for line in open(path).read().splitlines():
+        json.loads(line)       # whatever landed is whole
+
+
+# ------------------------------------------- sentinel ring under flight
+
+def test_sentinel_ring_append_during_flight_dump():
+    """Regression for the 'deque mutated during iteration' crash: the
+    reporter thread appends serve windows while the main thread's abort
+    path runs flight_dump — post-fix both sides hold the ring lock."""
+    bank = SentinelBank(MetricsRegistry())
+    stop = threading.Event()
+    errors = []
+
+    def reporter():
+        try:
+            while not stop.is_set():
+                bank.observe_serve({"serve_p99_ms": 5.0, "qps": 100.0})
+        except BaseException as e:
+            errors.append(e)
+
+    t = threading.Thread(target=reporter, name="cxxnet-test-reporter",
+                         daemon=True)
+    t.start()
+    try:
+        for _ in range(100):
+            bank.flight_dump("test")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors

@@ -4,6 +4,11 @@
 #      code (doc/lint.md): direct prints, non-atomic writes, swallowed
 #      thread exceptions, warn-once violations.  Zero findings required;
 #      deliberate exceptions carry inline `# disclint: ok(...)` pragmas;
+#   1b. racelint — the guarded-by concurrency lint over the host-side
+#      thread fleet (doc/lint.md): every cross-thread-mutated attribute
+#      carries a declared policy, guarded accesses hold their lock,
+#      every Thread carries a cxxnet-* name.  Zero findings required;
+#      suppressions need a written reason;
 #   2. graftlint --spmd over every shipped example config — zero
 #      error-severity findings required (the key registry and the
 #      configs must agree; tests/test_analysis.py mirrors this as the
@@ -28,6 +33,7 @@
 cd "$(dirname "$0")/.." || exit 1
 set -e
 python tools/disclint.py
+python cxxnet_tpu/analysis/racelint.py
 env JAX_PLATFORMS=cpu python tools/graftlint.py --spmd example/*/*.conf
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only \
     -p no:cacheprovider >/dev/null

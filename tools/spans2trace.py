@@ -40,6 +40,38 @@ sys.path.insert(0, _HERE)
 #: single-process export: every track hangs off one pid
 PID = 1
 
+#: thread-name prefix -> Perfetto sort rank, so tracks group by role
+#: instead of first-span order: the dispatch/scheduler plane on top,
+#: client threads next, then the background planes (checkpoint writer,
+#: admin/control threads, sentinel reporter, io producers).  Matched
+#: longest-prefix-first; unknown names sort after every known role.
+#: Keep in step with the racelint thread-naming rule (race_thread_name:
+#: every Thread carries a literal ``cxxnet-*`` name).
+THREAD_SORT_RANKS = (
+    ("cxxnet-serve-batcher", 0),
+    ("cxxnet-decode-sched", 0),
+    ("cxxnet-serve-client", 10),
+    ("cxxnet-serve-gen", 10),
+    ("cxxnet-bench-client", 10),
+    ("cxxnet-bench-genclient", 10),
+    ("cxxnet-ckpt-writer", 20),
+    ("cxxnet-serve-admin", 30),
+    ("cxxnet-serve-sentinel", 40),
+    ("cxxnet-serve-producer", 50),
+    ("cxxnet-imbin", 50),
+    ("cxxnet-io-buffer-producer", 50),
+    ("cxxnet-device-prefetch", 50),
+)
+
+
+def sort_rank(name: str) -> int:
+    best = 90    # unknown roles (incl. MainThread) sort last
+    best_len = -1
+    for prefix, rank in THREAD_SORT_RANKS:
+        if name.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = rank, len(prefix)
+    return best
+
 
 def load_spans(path: str) -> List[dict]:
     from obsv import load_records
@@ -57,6 +89,11 @@ def build_trace(spans: List[dict]) -> dict:
             tids[name] = len(tids) + 1
             events.append({"ph": "M", "name": "thread_name", "pid": PID,
                            "tid": tids[name], "args": {"name": name}})
+            # within-rank tiebreak on tid keeps e.g. client-0..N in order
+            events.append({"ph": "M", "name": "thread_sort_index",
+                           "pid": PID, "tid": tids[name],
+                           "args": {"sort_index":
+                                    sort_rank(name) * 1000 + tids[name]}})
         return tids[name]
 
     # rider trace_id -> its coalesce span (the flow arrow's tail: the
